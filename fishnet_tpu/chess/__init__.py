@@ -1,0 +1,32 @@
+"""Host-side chess rules library (shakmaty's role in the reference client)."""
+from .types import (
+    BLACK,
+    BISHOP,
+    KING,
+    KNIGHT,
+    PAWN,
+    QUEEN,
+    ROOK,
+    WHITE,
+    Move,
+    parse_square,
+    square,
+    square_file,
+    square_name,
+    square_rank,
+)
+from .position import (
+    Chess960Position,
+    IllegalMoveError,
+    InvalidFenError,
+    Position,
+    STARTING_FEN,
+)
+from .perft import perft, perft_divide
+
+__all__ = [
+    "BLACK", "BISHOP", "KING", "KNIGHT", "PAWN", "QUEEN", "ROOK", "WHITE",
+    "Move", "parse_square", "square", "square_file", "square_name", "square_rank",
+    "Chess960Position", "IllegalMoveError", "InvalidFenError", "Position",
+    "STARTING_FEN", "perft", "perft_divide",
+]

@@ -1,0 +1,23 @@
+"""Perft (move-path enumeration) for validating the rules library."""
+from __future__ import annotations
+
+from .position import Position
+
+
+def perft(pos: Position, depth: int) -> int:
+    if depth == 0:
+        return 1
+    moves = pos.legal_moves()
+    if depth == 1:
+        return len(moves)
+    total = 0
+    for move in moves:
+        total += perft(pos.push(move), depth - 1)
+    return total
+
+
+def perft_divide(pos: Position, depth: int) -> dict:
+    out = {}
+    for move in pos.legal_moves():
+        out[move.uci()] = perft(pos.push(move), depth - 1) if depth > 1 else 1
+    return out
