@@ -1,0 +1,171 @@
+"""Variant rules tests.
+
+Shallow perft of most variants from the start equals standard chess (rule
+differences only bite after the first capture/check), which pins the
+inheritance wiring. Hand-computed anchors cover the divergent rules.
+"""
+import pytest
+
+from fishnet_tpu.chess import Move, Position, perft
+from fishnet_tpu.chess.variants import (
+    AntichessPosition,
+    AtomicPosition,
+    CrazyhousePosition,
+    HordePosition,
+    KingOfTheHillPosition,
+    RacingKingsPosition,
+    ThreeCheckPosition,
+    from_fen,
+    position_class,
+)
+
+
+def test_variant_registry():
+    assert position_class("standard") is Position
+    assert position_class("threeCheck") is ThreeCheckPosition
+    with pytest.raises(ValueError):
+        position_class("shogi")
+
+
+@pytest.mark.parametrize("cls", [ThreeCheckPosition, KingOfTheHillPosition,
+                                 AtomicPosition, CrazyhousePosition])
+def test_variant_shallow_perft_matches_standard(cls):
+    pos = cls.initial()
+    assert perft(pos, 1) == 20
+    assert perft(pos, 2) == 400
+
+
+def test_racing_kings_start():
+    pos = RacingKingsPosition.initial()
+    # hand-verified: Ne2{d4,f4,g3} (Nc3 would check), Ne1{xc2,d3,f3},
+    # Bf2{e3,d4,c5,b6,a7,g3,h4}, Rg2{g3..g8}, Kh2{g3,h3}
+    assert len(pos.legal_moves()) == 21
+
+
+def test_racing_kings_win_and_rejoinder():
+    pos = RacingKingsPosition.from_fen("4K3/8/8/8/8/8/1k6/8 b - - 0 1")
+    # white king reached rank 8, black king too far: white wins
+    assert pos.outcome() == (0, "king in the goal")
+    pos = RacingKingsPosition.from_fen("4K3/1k6/8/8/8/8/8/8 b - - 0 1")
+    # black can still step onto rank 8: game not over yet
+    assert pos.outcome() is None
+    both = pos.push_uci("b7b8")
+    assert both.outcome() == (None, "both kings in the goal")
+
+
+def test_horde_start():
+    pos = HordePosition.initial()
+    assert perft(pos, 1) == 8  # hand-verified
+    assert perft(pos, 2) == 128  # hand-verified (17*4 + 15*4 black replies)
+    # rank-1 horde pawns may double push once unblocked
+    p = HordePosition.from_fen("k7/8/8/8/8/8/8/4P3 w - - 0 1")
+    ucis = {m.uci() for m in p.legal_moves()}
+    assert "e1e2" in ucis and "e1e3" in ucis
+
+
+def test_horde_destroyed():
+    pos = HordePosition.from_fen("k7/1P6/8/8/8/8/8/8 b - - 0 1")
+    child = pos.push_uci("a8b7")  # black king captures the last horde pawn
+    assert child.outcome() == (1, "horde destroyed")
+
+
+def test_three_check_outcome():
+    pos = ThreeCheckPosition.from_fen(
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 1+3 0 1"
+    )
+    assert pos.checks_given == [2, 0]
+    # deliver the third check
+    pos = ThreeCheckPosition.from_fen("4k3/8/8/8/8/8/8/4KQ2 w - - 1+3 0 1")
+    child = pos.push_uci("f1f7")
+    assert child.outcome() == (0, "three checks")
+
+
+def test_koth_outcome():
+    pos = KingOfTheHillPosition.from_fen("4k3/8/8/8/8/4K3/8/8 w - - 0 1")
+    child = pos.push_uci("e3e4")
+    assert child.outcome() == (0, "king in the center")
+
+
+def test_atomic_explosion():
+    # white queen takes d5 pawn: explosion removes knight c6 & bishop e6
+    pos = AtomicPosition.from_fen("k7/8/2n1b3/3p4/8/8/8/K2Q4 w - - 0 1")
+    child = pos.push_uci("d1d5")
+    fen = child.to_fen()
+    assert child.piece_at(35) is None  # queen exploded
+    assert child.bbs[1][1] == 0  # knight gone
+    assert child.bbs[1][2] == 0  # bishop gone
+
+
+def test_atomic_pawns_survive_explosion():
+    pos = AtomicPosition.from_fen("k7/8/8/2pp4/3P4/8/8/K7 w - - 0 1")
+    child = pos.push_uci("d4c5")
+    # captured c5 gone, capturer gone, but d5 pawn survives (pawns immune)
+    assert child.piece_at(34) is None
+    assert child.piece_at(35) is not None
+
+
+def test_atomic_king_cannot_capture():
+    pos = AtomicPosition.from_fen("k7/8/8/8/8/8/1p6/K7 w - - 0 1")
+    ucis = {m.uci() for m in pos.legal_moves()}
+    assert "a1b2" not in ucis
+
+
+def test_atomic_adjacent_kings_no_check():
+    pos = AtomicPosition.from_fen("8/8/8/8/8/1k6/1K6/4Q3 w - - 0 1")
+    assert not pos.is_check()
+    child = pos.push_uci("e1e3")  # queen checks... but kings adjacent
+    assert not child.is_check()
+
+
+def test_atomic_win_by_explosion():
+    pos = AtomicPosition.from_fen("kr6/8/8/8/8/8/8/KQ6 w - - 0 1")
+    child = pos.push_uci("b1b8")  # Qxb8 explodes the a8 king
+    assert child.outcome() == (0, "king exploded")
+
+
+def test_antichess_forced_capture():
+    pos = AntichessPosition.from_fen("8/8/8/8/3p4/2P5/8/8 w - - 0 1")
+    ucis = {m.uci() for m in pos.legal_moves()}
+    assert ucis == {"c3d4"}  # capture is mandatory
+
+
+def test_antichess_king_promotion_and_stalemate_win():
+    pos = AntichessPosition.from_fen("8/P7/8/8/8/8/8/8 w - - 0 1")
+    ucis = {m.uci() for m in pos.legal_moves()}
+    assert "a7a8k" in ucis
+    lost = AntichessPosition.from_fen("8/8/8/8/8/8/8/8 w - - 0 1")
+    # no pieces: side to move wins
+    assert lost.outcome() == (0, "all pieces lost")
+
+
+def test_crazyhouse_pocket_and_drop():
+    pos = CrazyhousePosition.from_fen(
+        "rnbqkbnr/ppp1pppp/8/3p4/4P3/8/PPPP1PPP/RNBQKBNR w KQkq - 0 2"
+    )
+    child = pos.push_uci("e4d5")
+    assert child.pockets[0][0] == 1  # white pawn in pocket
+    fen = child.to_fen()
+    assert "[P]" in fen
+    # round-trip and drop
+    again = CrazyhousePosition.from_fen(fen)
+    assert again.pockets[0][0] == 1
+    after_black = child.push_uci("g8f6")
+    drop = after_black.push_uci("P@e5")
+    assert drop.piece_at(36) == (0, 0)
+    assert drop.pockets[0][0] == 0
+
+
+def test_crazyhouse_no_pawn_drop_on_back_rank():
+    pos = CrazyhousePosition.from_fen("k7/8/8/8/8/8/8/K7[Pp] w - - 0 1")
+    ucis = {m.uci() for m in pos.legal_moves()}
+    assert "P@e4" in ucis
+    assert not any(u.startswith("P@") and (u.endswith("1") or u.endswith("8")) for u in ucis)
+
+
+def test_crazyhouse_promoted_capture_gives_pawn():
+    pos = CrazyhousePosition.from_fen("k6K/8/8/8/8/8/p7/1R6[] b - - 0 1")
+    promoted = pos.push_uci("a2a1q")
+    assert promoted.to_fen().startswith("k6K/8/8/8/8/8/8/q~R5")
+    captured = promoted.push_uci("b1a1")
+    assert captured.pockets[0][0] == 1  # promoted queen reverts to pawn
+    assert captured.pockets[0][4] == 0
