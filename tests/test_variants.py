@@ -165,7 +165,7 @@ def test_crazyhouse_no_pawn_drop_on_back_rank():
 def test_crazyhouse_promoted_capture_gives_pawn():
     pos = CrazyhousePosition.from_fen("k6K/8/8/8/8/8/p7/1R6[] b - - 0 1")
     promoted = pos.push_uci("a2a1q")
-    assert promoted.to_fen().startswith("k6K/8/8/8/8/8/8/q~R5")
+    assert promoted.to_fen().startswith("k6K/8/8/8/8/8/8/q~R6")
     captured = promoted.push_uci("b1a1")
     assert captured.pockets[0][0] == 1  # promoted queen reverts to pawn
     assert captured.pockets[0][4] == 0
