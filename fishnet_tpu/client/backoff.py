@@ -1,0 +1,24 @@
+"""Randomized exponential backoff (behavioral parity with the reference's
+RandomizedBackoff — reference: src/util.rs:11-40)."""
+from __future__ import annotations
+
+import random
+
+
+class RandomizedBackoff:
+    """Each call draws uniform(100ms, 4×max(100ms, last)) capped at max_s."""
+
+    def __init__(self, max_s: float = 30.0) -> None:
+        self.max_s = max_s
+        self._last_ms = 0
+
+    def next(self) -> float:
+        low = 100
+        cap = max(low, int(self.max_s * 1000))
+        high = 4 * max(low, self._last_ms)
+        t = min(cap, random.randint(low, max(low, high - 1)))
+        self._last_ms = t
+        return t / 1000.0
+
+    def reset(self) -> None:
+        self._last_ms = 0

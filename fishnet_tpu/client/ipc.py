@@ -1,0 +1,118 @@
+"""Work types crossing the queue↔worker↔engine boundary.
+
+Python analogue of the reference's IPC layer (reference: src/ipc.rs:13-118).
+In this framework a "chunk" is also the unit handed to the TPU engine, which
+may batch many chunks into one device dispatch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .wire import (
+    AnalysisPartBest,
+    AnalysisPartMatrix,
+    EngineFlavor,
+    MAX_CHUNK_POSITIONS,
+    Score,
+    Work,
+)
+
+
+@dataclass
+class WorkPosition:
+    """One position to analyse (reference: src/ipc.rs:26-35).
+
+    position_index None marks a chunk-overlap warm-up position whose result
+    is discarded (reference: src/queue.rs:642-681).
+    """
+
+    work: Work
+    position_index: Optional[int]
+    url: Optional[str]
+    skip: bool
+    root_fen: str
+    moves: List[str]
+
+
+@dataclass
+class Chunk:
+    """≤6 positions dispatched to one engine as a unit (src/ipc.rs:13-24)."""
+
+    work: Work
+    deadline: float  # time.monotonic() timestamp
+    variant: str
+    flavor: EngineFlavor
+    positions: List[WorkPosition]
+
+    MAX_POSITIONS = MAX_CHUNK_POSITIONS
+
+
+class Matrix:
+    """Sparse [multipv-1][depth] matrix; best() = first row, last entry
+    (reference: src/ipc.rs:76-96)."""
+
+    def __init__(self) -> None:
+        self.matrix: List[List[Optional[object]]] = []
+
+    def set(self, multipv: int, depth: int, value) -> None:
+        row_idx = multipv - 1
+        while len(self.matrix) <= row_idx:
+            self.matrix.append([])
+        row = self.matrix[row_idx]
+        while len(row) <= depth:
+            row.append(None)
+        row[depth] = value
+
+    def best(self):
+        if not self.matrix or not self.matrix[0]:
+            return None
+        return self.matrix[0][-1]
+
+
+@dataclass
+class PositionResponse:
+    """Result for one position (reference: src/ipc.rs:37-74)."""
+
+    work: Work
+    position_index: Optional[int]
+    url: Optional[str]
+    scores: Matrix
+    pvs: Matrix
+    best_move: Optional[str]
+    depth: int
+    nodes: int
+    time_s: float
+    nps: Optional[int] = None
+
+    def to_best(self) -> AnalysisPartBest:
+        best_score = self.scores.best()
+        assert best_score is not None, "position response without score"
+        pv = self.pvs.best()
+        return AnalysisPartBest(
+            pv=list(pv) if pv else [],
+            score=best_score,
+            depth=self.depth,
+            nodes=self.nodes,
+            time_ms=int(self.time_s * 1000),
+            nps=self.nps,
+        )
+
+    def into_matrix(self) -> AnalysisPartMatrix:
+        return AnalysisPartMatrix(
+            pv=[list(row) for row in self.pvs.matrix],
+            score=[list(row) for row in self.scores.matrix],
+            depth=self.depth,
+            nodes=self.nodes,
+            time_ms=int(self.time_s * 1000),
+            nps=self.nps,
+        )
+
+
+class ChunkFailed(Exception):
+    """Engine-side failure; the batch is forgotten so the server re-queues it
+    by timeout (reference: src/queue.rs:226-233)."""
+
+    def __init__(self, batch_id: str):
+        super().__init__(f"chunk failed for batch {batch_id}")
+        self.batch_id = batch_id

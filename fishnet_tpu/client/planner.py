@@ -1,0 +1,265 @@
+"""Batch planner: validates acquired work and plans it into chunks.
+
+Behavioral parity with the reference's IncomingBatch::from_acquired
+(reference: src/queue.rs:546-700): FEN + every UCI move re-validated by
+replay, engine flavor chosen, per-ply positions built *in reverse* (backwards
+analysis so mate scores propagate naturally), tiled into chunks of ≤6 with a
+one-position overlap that warms engine state and is discarded
+(position_index None). The TPU backend doesn't need warm-up overlap — it
+analyses whole batches at once — but the chunk plan is kept identical so the
+subprocess path and accounting stay compatible.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Set
+
+from ..chess.position import IllegalMoveError, InvalidFenError
+from ..chess.variants import from_fen
+from .ipc import Chunk, WorkPosition
+from .wire import (
+    AcquireResponseBody,
+    AnalysisPartSkipped,
+    AnalysisWork,
+    EngineFlavor,
+    MoveWork,
+)
+
+SKIP = "skip"  # sentinel marking a skipped position slot
+
+
+class IncomingError(Exception):
+    pass
+
+
+class AllSkipped(IncomingError):
+    """Batch completes immediately: every position was skipped
+    (reference: src/queue.rs:684-694)."""
+
+    def __init__(self, completed: "CompletedBatch"):
+        super().__init__("all positions skipped")
+        self.completed = completed
+
+
+@dataclass
+class IncomingBatch:
+    work: object
+    url: Optional[str]
+    flavor: EngineFlavor
+    variant: str
+    chunks: List[Chunk]
+
+    @staticmethod
+    def from_acquired(
+        endpoint_url: str,
+        body: AcquireResponseBody,
+        tpu_variants: Optional[Set[str]] = None,
+        tpu_moves: bool = False,
+        now: Optional[float] = None,
+    ) -> "IncomingBatch":
+        """Validate and plan an acquired batch.
+
+        tpu_variants: variants the TPU engine handles for analysis jobs;
+        tpu_moves: whether move jobs also route to the TPU engine. With both
+        unset the flavor choice matches the reference exactly
+        (reference: src/queue.rs:562-568).
+        """
+        url = body.batch_url(endpoint_url)
+        now = time.monotonic() if now is None else now
+
+        try:
+            root_pos = from_fen(body.position, body.variant)
+        except (InvalidFenError, ValueError) as e:
+            raise IncomingError(f"invalid position: {e}") from e
+
+        is_standard_chess = body.variant in ("standard", "chess960", "fromPosition")
+        if body.work.is_analysis and is_standard_chess:
+            flavor = (
+                EngineFlavor.TPU
+                if tpu_variants and body.variant in tpu_variants
+                else EngineFlavor.OFFICIAL
+            )
+        else:
+            # variants and *all* move jobs go to the multi-variant engine
+            flavor = (
+                EngineFlavor.TPU
+                if tpu_variants
+                and body.variant in tpu_variants
+                and (body.work.is_analysis or tpu_moves)
+                else EngineFlavor.MULTI_VARIANT
+            )
+
+        root_fen = root_pos.to_fen()
+
+        # replay every move, re-encoding into Chess960-style UCI
+        body_moves: List[str] = []
+        pos = root_pos
+        for uci in body.moves:
+            try:
+                move = pos.parse_uci(uci)
+            except (IllegalMoveError, ValueError) as e:
+                raise IncomingError(f"illegal uci move: {e}") from e
+            body_moves.append(move.uci())
+            pos = pos.push(move)
+
+        if isinstance(body.work, MoveWork):
+            chunk = Chunk(
+                work=body.work,
+                deadline=now + body.work.timeout_per_ply(),
+                flavor=flavor,
+                variant=body.variant,
+                positions=[
+                    WorkPosition(
+                        work=body.work,
+                        url=url,
+                        skip=False,
+                        position_index=0,
+                        root_fen=root_fen,
+                        moves=body_moves,
+                    )
+                ],
+            )
+            return IncomingBatch(body.work, url, flavor, body.variant, [chunk])
+
+        assert isinstance(body.work, AnalysisWork)
+        num_positions = len(body_moves) + 1
+        deadline = now + body.work.timeout_per_ply() * num_positions
+        skip_set = set(body.skip_positions)
+
+        positions: List[WorkPosition] = []
+        for index in range(num_positions):
+            positions.append(
+                WorkPosition(
+                    work=body.work,
+                    url=f"{url}#{index}" if url else None,
+                    skip=index in skip_set,
+                    position_index=index,
+                    root_fen=root_fen,
+                    moves=body_moves[:index],
+                )
+            )
+
+        # analyse backwards (reference: src/queue.rs:639-640)
+        positions.reverse()
+
+        # pair every position with its predecessor-in-analysis-order, which
+        # becomes a discarded warm-up overlap at chunk boundaries
+        prevs: List[Optional[WorkPosition]] = [None]
+        for p in positions[:-1]:
+            prevs.append(dataclasses.replace(p, position_index=None))
+
+        chunks: List[Chunk] = []
+        group_size = Chunk.MAX_POSITIONS - 1
+        pairs = list(zip(prevs, positions))
+        for start in range(0, len(pairs), group_size):
+            chunk_positions: List[WorkPosition] = []
+            for prev, current in pairs[start : start + group_size]:
+                if current.skip:
+                    continue
+                if prev is not None and (prev.skip or not chunk_positions):
+                    chunk_positions.append(prev)
+                chunk_positions.append(current)
+            if chunk_positions:
+                chunks.append(
+                    Chunk(
+                        work=body.work,
+                        deadline=deadline,
+                        flavor=flavor,
+                        variant=body.variant,
+                        positions=chunk_positions,
+                    )
+                )
+
+        if not chunks:
+            raise AllSkipped(
+                CompletedBatch(
+                    work=body.work,
+                    url=url,
+                    flavor=flavor,
+                    variant=body.variant,
+                    positions=[SKIP] * num_positions,
+                    total_nodes=0,
+                    total_cpu_time=0.0,
+                )
+            )
+
+        return IncomingBatch(body.work, url, flavor, body.variant, chunks)
+
+
+@dataclass
+class PendingBatch:
+    """Sparse reassembly buffer (reference: src/queue.rs:745-789)."""
+
+    work: object
+    url: Optional[str]
+    flavor: EngineFlavor
+    variant: str
+    positions: List[object]  # None (outstanding) | SKIP | PositionResponse
+    total_nodes: int = 0
+    total_cpu_time: float = 0.0
+
+    def pending(self) -> int:
+        return sum(1 for p in self.positions if p is None)
+
+    def try_into_completed(self) -> Optional["CompletedBatch"]:
+        if any(p is None for p in self.positions):
+            return None
+        return CompletedBatch(
+            work=self.work,
+            url=self.url,
+            flavor=self.flavor,
+            variant=self.variant,
+            positions=list(self.positions),
+            total_nodes=self.total_nodes,
+            total_cpu_time=self.total_cpu_time,
+        )
+
+    def progress_report(self) -> List[Optional[dict]]:
+        """Quirk: lila distinguishes progress reports from complete analysis
+        by the first part being null (reference: src/queue.rs:773-784)."""
+        out: List[Optional[dict]] = []
+        for i, p in enumerate(self.positions):
+            if i > 0 and p is not None and p is not SKIP:
+                out.append(p.to_best().to_json())
+            else:
+                out.append(None)
+        return out
+
+
+@dataclass
+class CompletedBatch:
+    """Fully analysed batch (reference: src/queue.rs:791-838)."""
+
+    work: object
+    url: Optional[str]
+    flavor: EngineFlavor
+    variant: str
+    positions: List[object]  # SKIP | PositionResponse
+    total_nodes: int
+    total_cpu_time: float
+
+    def into_analysis(self) -> List[Optional[dict]]:
+        out = []
+        for p in self.positions:
+            if p is SKIP:
+                out.append(AnalysisPartSkipped().to_json())
+            elif p.work.matrix_wanted():
+                out.append(p.into_matrix().to_json())
+            else:
+                out.append(p.to_best().to_json())
+        return out
+
+    def into_best_move(self) -> Optional[str]:
+        if not self.positions or self.positions[0] is SKIP:
+            return None
+        return self.positions[0].best_move
+
+    def total_positions(self) -> int:
+        return sum(1 for p in self.positions if p is not SKIP)
+
+    def nps(self) -> Optional[int]:
+        if self.total_cpu_time <= 0:
+            return None
+        return int(self.total_nodes / self.total_cpu_time)

@@ -1,0 +1,305 @@
+"""Wire model for the fishnet HTTP protocol.
+
+Mirrors the serde types of the reference client (reference: src/api.rs:120-403
+and doc/protocol.md) as plain dataclasses with explicit to/from JSON-dict
+conversion. The protocol is the compatibility contract: a lichess server (or
+lila-fishnet) must not be able to tell this client from the reference.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+MAX_CHUNK_POSITIONS = 6  # reference: src/ipc.rs:23
+
+
+class EngineFlavor(enum.Enum):
+    """Which engine backend a chunk is routed to.
+
+    The reference has Official (Stockfish) and MultiVariant (Fairy-Stockfish)
+    (reference: src/assets.rs:124-137); this framework adds TPU, the batched
+    JAX/Pallas engine.
+    """
+
+    OFFICIAL = "official"
+    MULTI_VARIANT = "multivariant"
+    TPU = "tpu"
+
+    def eval_flavor(self) -> "EvalFlavor":
+        # Official runs NNUE, MultiVariant runs HCE (reference:
+        # src/assets.rs:130-137); the TPU engine evaluates with NNUE weights.
+        if self is EngineFlavor.MULTI_VARIANT:
+            return EvalFlavor.HCE
+        return EvalFlavor.NNUE
+
+
+class EvalFlavor(enum.Enum):
+    NNUE = "nnue"
+    HCE = "classical"
+
+    def to_json(self) -> str:
+        return self.value
+
+
+@dataclass(frozen=True)
+class NodeLimit:
+    """Per-position node budget keyed by engine generation.
+
+    `get` pre-scales by MAX/(MAX+1) to pay for the chunk-overlap position
+    (reference: src/api.rs:220-233).
+    """
+
+    sf16: int
+    classical: int
+
+    def get(self, flavor: EvalFlavor) -> int:
+        base = self.classical if flavor is EvalFlavor.HCE else self.sf16
+        return base * MAX_CHUNK_POSITIONS // (MAX_CHUNK_POSITIONS + 1)
+
+    @staticmethod
+    def from_json(obj: dict) -> "NodeLimit":
+        return NodeLimit(sf16=int(obj["sf16"]), classical=int(obj["classical"]))
+
+
+# Skill level 1-8 → (movetime ms, engine Skill Level, depth)
+# (reference: src/api.rs:248-283)
+_SKILL_TABLE = {
+    1: (50, -9, 5),
+    2: (100, -5, 5),
+    3: (150, -1, 5),
+    4: (200, 3, 5),
+    5: (300, 7, 5),
+    6: (400, 11, 8),
+    7: (500, 16, 13),
+    8: (1000, 20, 22),
+}
+
+
+@dataclass(frozen=True)
+class SkillLevel:
+    level: int  # 1..8
+
+    def __post_init__(self):
+        if not 1 <= self.level <= 8:
+            raise ValueError(f"skill level out of range: {self.level}")
+
+    @property
+    def movetime_ms(self) -> int:
+        return _SKILL_TABLE[self.level][0]
+
+    @property
+    def engine_skill_level(self) -> int:
+        return _SKILL_TABLE[self.level][1]
+
+    @property
+    def depth(self) -> int:
+        return _SKILL_TABLE[self.level][2]
+
+
+@dataclass(frozen=True)
+class Clock:
+    wtime_centis: int
+    btime_centis: int
+    inc_seconds: int
+
+    @staticmethod
+    def from_json(obj: dict) -> "Clock":
+        return Clock(
+            wtime_centis=int(obj["wtime"]),
+            btime_centis=int(obj["btime"]),
+            inc_seconds=int(obj["inc"]),
+        )
+
+
+@dataclass(frozen=True)
+class AnalysisWork:
+    id: str
+    nodes: NodeLimit
+    timeout_s: float  # per ply
+    depth: Optional[int] = None
+    multipv: Optional[int] = None
+
+    def timeout_per_ply(self) -> float:
+        return self.timeout_s
+
+    @property
+    def is_analysis(self) -> bool:
+        return True
+
+    @property
+    def is_move(self) -> bool:
+        return False
+
+    def effective_multipv(self) -> int:
+        return self.multipv or 1
+
+    def matrix_wanted(self) -> bool:
+        return self.multipv is not None
+
+
+@dataclass(frozen=True)
+class MoveWork:
+    id: str
+    level: SkillLevel
+    clock: Optional[Clock] = None
+
+    def timeout_per_ply(self) -> float:
+        return 7.0  # reference: src/api.rs:163-168
+
+    @property
+    def is_analysis(self) -> bool:
+        return False
+
+    @property
+    def is_move(self) -> bool:
+        return True
+
+    def effective_multipv(self) -> int:
+        return 1
+
+    def matrix_wanted(self) -> bool:
+        return False
+
+
+Work = Union[AnalysisWork, MoveWork]
+
+
+def work_from_json(obj: dict) -> Work:
+    batch_id = str(obj["id"])
+    if len(batch_id) > 24:
+        raise ValueError(f"batch id too long: {batch_id!r}")
+    if obj.get("type") == "analysis":
+        return AnalysisWork(
+            id=batch_id,
+            nodes=NodeLimit.from_json(obj["nodes"]),
+            timeout_s=int(obj["timeout"]) / 1000.0,
+            depth=int(obj["depth"]) if obj.get("depth") is not None else None,
+            multipv=int(obj["multipv"]) if obj.get("multipv") is not None else None,
+        )
+    if obj.get("type") == "move":
+        clock = obj.get("clock")
+        return MoveWork(
+            id=batch_id,
+            level=SkillLevel(int(obj["level"])),
+            clock=Clock.from_json(clock) if clock else None,
+        )
+    raise ValueError(f"unknown work type: {obj.get('type')!r}")
+
+
+@dataclass
+class AcquireResponseBody:
+    work: Work
+    position: str  # X-FEN
+    variant: str = "standard"
+    moves: List[str] = field(default_factory=list)
+    skip_positions: List[int] = field(default_factory=list)
+    game_id: Optional[str] = None
+
+    @staticmethod
+    def from_json(obj: dict) -> "AcquireResponseBody":
+        game_id = obj.get("game_id") or None  # empty string → None
+        moves_field = obj.get("moves", "")
+        moves = moves_field.split() if isinstance(moves_field, str) else list(moves_field)
+        return AcquireResponseBody(
+            work=work_from_json(obj["work"]),
+            game_id=game_id,
+            position=obj.get("position", STARTING_FEN_DEFAULT),
+            variant=obj.get("variant") or "standard",
+            moves=moves,
+            skip_positions=[int(i) for i in obj.get("skipPositions", [])],
+        )
+
+    def batch_url(self, endpoint_url: str) -> Optional[str]:
+        if not self.game_id:
+            return None
+        from urllib.parse import urlsplit, urlunsplit
+
+        parts = urlsplit(endpoint_url)
+        return urlunsplit((parts.scheme, parts.netloc, f"/{self.game_id}", "", ""))
+
+
+STARTING_FEN_DEFAULT = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+@dataclass(frozen=True)
+class Score:
+    """Either a centipawn or a mate score (reference: src/api.rs:391-397)."""
+
+    kind: str  # "cp" | "mate"
+    value: int
+
+    def to_json(self) -> dict:
+        return {self.kind: self.value}
+
+    @staticmethod
+    def cp(value: int) -> "Score":
+        return Score("cp", value)
+
+    @staticmethod
+    def mate(value: int) -> "Score":
+        return Score("mate", value)
+
+
+@dataclass
+class AnalysisPartSkipped:
+    def to_json(self) -> dict:
+        return {"skipped": True}
+
+
+@dataclass
+class AnalysisPartBest:
+    pv: List[str]
+    score: Score
+    depth: int
+    nodes: int
+    time_ms: int
+    nps: Optional[int] = None
+
+    def to_json(self) -> dict:
+        out = {
+            "score": self.score.to_json(),
+            "depth": self.depth,
+            "nodes": self.nodes,
+            "time": self.time_ms,
+        }
+        if self.pv:
+            out["pv"] = " ".join(self.pv)
+        if self.nps is not None:
+            out["nps"] = self.nps
+        return out
+
+
+@dataclass
+class AnalysisPartMatrix:
+    """Full multipv×depth matrices (reference: src/api.rs:380-389)."""
+
+    pv: List[List[Optional[List[str]]]]
+    score: List[List[Optional[Score]]]
+    depth: int
+    nodes: int
+    time_ms: int
+    nps: Optional[int] = None
+
+    def to_json(self) -> dict:
+        # matrix pv stays a nested array of UCI-move lists (reference:
+        # src/api.rs:381 — no string-join on the Matrix variant)
+        out = {
+            "pv": [
+                [list(pv) if pv is not None else None for pv in row]
+                for row in self.pv
+            ],
+            "score": [
+                [s.to_json() if s is not None else None for s in row]
+                for row in self.score
+            ],
+            "depth": self.depth,
+            "nodes": self.nodes,
+            "time": self.time_ms,
+        }
+        if self.nps is not None:
+            out["nps"] = self.nps
+        return out
+
+
+AnalysisPart = Union[AnalysisPartSkipped, AnalysisPartBest, AnalysisPartMatrix]

@@ -1,0 +1,188 @@
+"""Golden tests for the batch planner — the richest client logic
+(chunking/overlap/skip edge cases, reference: src/queue.rs:548-700)."""
+import pytest
+
+from fishnet_tpu.client.planner import (
+    SKIP,
+    AllSkipped,
+    CompletedBatch,
+    IncomingBatch,
+    IncomingError,
+    PendingBatch,
+)
+from fishnet_tpu.client.wire import (
+    AcquireResponseBody,
+    EngineFlavor,
+    MAX_CHUNK_POSITIONS,
+)
+from fishnet_tpu.client.ipc import Matrix, PositionResponse
+from fishnet_tpu.client.wire import Score
+
+ENDPOINT = "https://lichess.org/fishnet"
+
+
+def analysis_body(moves, skip=(), variant="standard", multipv=None):
+    return AcquireResponseBody.from_json({
+        "work": {
+            "type": "analysis",
+            "id": "job1",
+            "nodes": {"sf16": 1500000, "classical": 4050000},
+            "timeout": 7000,
+            **({"multipv": multipv} if multipv else {}),
+        },
+        "game_id": "abcdefgh",
+        "position": "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        "variant": variant,
+        "moves": " ".join(moves),
+        "skipPositions": list(skip),
+    })
+
+
+def move_body(moves, level=5):
+    return AcquireResponseBody.from_json({
+        "work": {"type": "move", "id": "mv1", "level": level},
+        "game_id": "abcdefgh",
+        "position": "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        "variant": "standard",
+        "moves": " ".join(moves),
+    })
+
+
+GAME_12 = "e2e4 c7c5 g1f3 d7d6 d2d4 c5d4 f3d4 g8f6 b1c3 a7a6 f1e2".split()
+
+
+def test_backwards_chunking_with_overlap():
+    batch = IncomingBatch.from_acquired(ENDPOINT, analysis_body(GAME_12))
+    # 11 moves → 12 positions, reversed, tiled in groups of 5 real positions
+    assert batch.flavor is EngineFlavor.OFFICIAL
+    chunks = batch.chunks
+    assert len(chunks) == 3
+    idx = [[p.position_index for p in c.positions] for c in chunks]
+    # first chunk starts at the last ply, no overlap available
+    assert idx[0] == [11, 10, 9, 8, 7]
+    # later chunks carry one discarded overlap position (None) up front
+    assert idx[1] == [None, 6, 5, 4, 3, 2]
+    assert idx[2] == [None, 1, 0]
+    # overlap of chunk 2 replays the position before (in analysis order)
+    assert len(chunks[1].positions[0].moves) == 7  # same moves as index 7
+    for c in chunks:
+        assert len(c.positions) <= MAX_CHUNK_POSITIONS
+
+
+def test_moves_reencoded_chess960_style():
+    # standard-notation castling e1g1 must re-encode as king-takes-rook e1h1
+    moves = "e2e4 e7e5 g1f3 b8c6 f1c4 g8f6 e1g1".split()
+    batch = IncomingBatch.from_acquired(ENDPOINT, analysis_body(moves))
+    deepest = batch.chunks[0].positions[0]
+    assert deepest.moves[-1] == "e1h1"
+
+
+def test_skip_positions():
+    batch = IncomingBatch.from_acquired(
+        ENDPOINT, analysis_body(GAME_12, skip=[11, 10, 3])
+    )
+    all_idx = [p.position_index for c in batch.chunks for p in c.positions]
+    assert 11 not in all_idx and 10 not in all_idx and 3 not in all_idx
+    # a skipped predecessor forces the overlap into the chunk
+    # (prev.skip || empty → push prev; reference: src/queue.rs:663-667)
+    assert all_idx.count(None) >= 1
+
+
+def test_all_skipped_completes_immediately():
+    with pytest.raises(AllSkipped) as exc:
+        IncomingBatch.from_acquired(
+            ENDPOINT, analysis_body(["e2e4"], skip=[0, 1])
+        )
+    completed = exc.value.completed
+    assert completed.positions == [SKIP, SKIP]
+    parts = completed.into_analysis()
+    assert parts == [{"skipped": True}, {"skipped": True}]
+
+
+def test_move_job_single_chunk():
+    batch = IncomingBatch.from_acquired(ENDPOINT, move_body(GAME_12))
+    assert batch.flavor is EngineFlavor.MULTI_VARIANT  # moves never Official
+    assert len(batch.chunks) == 1
+    (pos,) = batch.chunks[0].positions
+    assert pos.position_index == 0
+    assert pos.moves == GAME_12
+
+
+def test_variant_flavor():
+    body = analysis_body(["e2e4"], variant="kingOfTheHill")
+    batch = IncomingBatch.from_acquired(ENDPOINT, body)
+    assert batch.flavor is EngineFlavor.MULTI_VARIANT
+
+
+def test_tpu_flavor_routing():
+    batch = IncomingBatch.from_acquired(
+        ENDPOINT, analysis_body(GAME_12), tpu_variants={"standard"}
+    )
+    assert batch.flavor is EngineFlavor.TPU
+    # move jobs stay on the subprocess engine unless tpu_moves is set
+    mv = IncomingBatch.from_acquired(
+        ENDPOINT, move_body(GAME_12), tpu_variants={"standard"}
+    )
+    assert mv.flavor is EngineFlavor.MULTI_VARIANT
+    mv2 = IncomingBatch.from_acquired(
+        ENDPOINT, move_body(GAME_12), tpu_variants={"standard"}, tpu_moves=True
+    )
+    assert mv2.flavor is EngineFlavor.TPU
+
+
+def test_illegal_move_rejected():
+    with pytest.raises(IncomingError):
+        IncomingBatch.from_acquired(ENDPOINT, analysis_body(["e2e5"]))
+
+
+def test_invalid_fen_rejected():
+    body = analysis_body([])
+    body.position = "not a fen"
+    with pytest.raises(IncomingError):
+        IncomingBatch.from_acquired(ENDPOINT, body)
+
+
+def _response(work, index, nodes=1000):
+    scores = Matrix()
+    scores.set(1, 12, Score.cp(17))
+    pvs = Matrix()
+    pvs.set(1, 12, ["e2e4"])
+    return PositionResponse(
+        work=work, position_index=index, url=None, scores=scores, pvs=pvs,
+        best_move="e2e4", depth=12, nodes=nodes, time_s=0.5,
+    )
+
+
+def test_progress_report_first_part_none():
+    batch = IncomingBatch.from_acquired(ENDPOINT, analysis_body(["e2e4", "e7e5"]))
+    pending = PendingBatch(
+        work=batch.work, url=batch.url, flavor=batch.flavor,
+        variant=batch.variant, positions=[None, None, None],
+    )
+    pending.positions[0] = _response(batch.work, 0)
+    pending.positions[1] = _response(batch.work, 1)
+    report = pending.progress_report()
+    # lila quirk: first part must be None even though it is present
+    assert report[0] is None
+    assert report[1] is not None
+    assert report[2] is None
+    assert pending.try_into_completed() is None
+    pending.positions[2] = _response(batch.work, 2)
+    completed = pending.try_into_completed()
+    assert completed is not None
+    assert len(completed.into_analysis()) == 3
+
+
+def test_node_budget_overlap_scaling():
+    body = analysis_body(["e2e4"])
+    # 6/7 scaling pays for the overlap position (reference: src/api.rs:220-233)
+    assert body.work.nodes.get(EngineFlavor.OFFICIAL.eval_flavor()) == 1500000 * 6 // 7
+    assert body.work.nodes.get(EngineFlavor.MULTI_VARIANT.eval_flavor()) == 4050000 * 6 // 7
+
+
+def test_nps_accounting():
+    completed = CompletedBatch(
+        work=None, url=None, flavor=EngineFlavor.OFFICIAL, variant="standard",
+        positions=[], total_nodes=3_000_000, total_cpu_time=2.0,
+    )
+    assert completed.nps() == 1_500_000
