@@ -651,12 +651,17 @@ class Position:
         )
         return minors <= 1
 
-    def outcome(self) -> Optional[Tuple[Optional[int], str]]:
-        """Return (winner_color_or_None_for_draw, reason) if game is over."""
+    def outcome(self, legal_moves: Optional[List[Move]] = None) -> Optional[Tuple[Optional[int], str]]:
+        """Return (winner_color_or_None_for_draw, reason) if game is over.
+
+        Pass precomputed `legal_moves` to avoid regenerating them (search
+        engines call this once per node)."""
         special = self._variant_outcome()
         if special is not None:
             return special
-        if not self.legal_moves():
+        if legal_moves is None:
+            legal_moves = self.legal_moves()
+        if not legal_moves:
             if self.is_check():
                 return (self.turn ^ 1, "checkmate")
             return (None, "stalemate")

@@ -282,10 +282,13 @@ class AntichessPosition(Position):
             return (self.turn, "stalemate")  # stalemated side wins
         return None
 
-    def outcome(self):
-        special = self._variant_outcome()
-        if special is not None:
-            return special
+    def outcome(self, legal_moves=None):
+        if not self.occ[self.turn]:
+            return (self.turn, "all pieces lost")
+        if legal_moves is None:
+            legal_moves = self.legal_moves()
+        if not legal_moves:
+            return (self.turn, "stalemate")  # stalemated side wins
         if self.halfmove >= 100:
             return (None, "50-move rule")
         return None

@@ -1,0 +1,1 @@
+"""Client framework: protocol, planner, queue, workers, config, stats."""

@@ -1,0 +1,232 @@
+"""HTTP client for the fishnet work-stealing protocol.
+
+Owns all northbound traffic like the reference's ApiActor (reference:
+src/api.rs:481-756): acquire, submit analysis, submit move (with job
+chaining), abort, status, key check. Error handling parity: per-request
+randomized backoff, HTTP 429 → ≥60 s suspension (reference:
+src/api.rs:516-535), acquire rejections (400/401/403/406) signal the client
+to stop (reference: src/api.rs:649-678, doc/protocol.md:240-244).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .. import __version__
+from .backoff import RandomizedBackoff
+from .wire import AcquireResponseBody, EvalFlavor
+
+
+@dataclass
+class Endpoint:
+    """Server endpoint; any non-lichess.org host counts as a development
+    server that may run keyless (reference: src/configure.rs:90-125)."""
+
+    url: str = "https://lichess.org/fishnet"
+
+    def __post_init__(self):
+        self.url = self.url.rstrip("/")
+
+    @property
+    def is_development(self) -> bool:
+        from urllib.parse import urlsplit
+
+        host = urlsplit(self.url).hostname or ""
+        return host != "lichess.org"
+
+    def join(self, path: str) -> str:
+        return f"{self.url}/{path.lstrip('/')}"
+
+    def __str__(self) -> str:
+        return self.url
+
+
+class AcquiredKind:
+    ACCEPTED = "accepted"
+    NO_CONTENT = "no_content"
+    REJECTED = "rejected"
+
+
+@dataclass
+class Acquired:
+    kind: str
+    body: Optional[AcquireResponseBody] = None
+
+
+@dataclass
+class QueueStatus:
+    user_oldest: float
+    system_oldest: float
+
+
+@dataclass
+class HttpResponse:
+    status: int
+    body: bytes
+
+    def json(self):
+        return json.loads(self.body.decode("utf-8"))
+
+
+class UrllibTransport:
+    """Blocking stdlib transport, run on the event loop's executor."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout  # reference: src/main.rs:451 (30 s)
+
+    def request(
+        self, method: str, url: str, headers: dict, body: Optional[bytes]
+    ) -> HttpResponse:
+        req = urllib.request.Request(url, data=body, headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return HttpResponse(resp.status, resp.read())
+        except urllib.error.HTTPError as e:
+            return HttpResponse(e.code, e.read())
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, msg: str = ""):
+        super().__init__(f"HTTP {status} {msg}")
+        self.status = status
+
+
+class ApiClient:
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        key: Optional[str],
+        transport=None,
+        logger=None,
+        max_backoff_s: float = 30.0,
+    ) -> None:
+        self.endpoint = endpoint
+        self.key = key
+        self.transport = transport or UrllibTransport()
+        self.logger = logger
+        self.backoff = RandomizedBackoff(max_backoff_s)
+        self._suspended_until = 0.0
+
+    # ------------------------------------------------------------- low level
+
+    def _headers(self, with_body: bool) -> dict:
+        headers = {
+            # reference sends fishnet-<os>-<arch>/<version> (src/main.rs:444-449)
+            "User-Agent": f"fishnet-tpu/{__version__}",
+        }
+        if with_body:
+            headers["Content-Type"] = "application/json"
+        if self.key:
+            headers["Authorization"] = f"Bearer {self.key}"
+        return headers
+
+    def _fishnet_body(self) -> dict:
+        return {"fishnet": {"version": __version__, "apikey": self.key or ""}}
+
+    async def _request(
+        self, method: str, url: str, body: Optional[dict] = None
+    ) -> HttpResponse:
+        loop = asyncio.get_running_loop()
+        now = loop.time()
+        if now < self._suspended_until:
+            await asyncio.sleep(self._suspended_until - now)
+        payload = json.dumps(body).encode() if body is not None else None
+        try:
+            resp = await loop.run_in_executor(
+                None,
+                self.transport.request,
+                method,
+                url,
+                self._headers(payload is not None),
+                payload,
+            )
+        except Exception as e:  # network failure → backoff and propagate
+            delay = self.backoff.next()
+            if self.logger:
+                self.logger.warn(f"{method} {url} failed: {e}; backing off {delay:.1f}s")
+            await asyncio.sleep(delay)
+            raise ApiError(0, str(e)) from e
+        if resp.status == 429:
+            # rate limited: suspend all requests for at least 60 s
+            self._suspended_until = loop.time() + 60.0 + self.backoff.next()
+            if self.logger:
+                self.logger.warn("Rate limited (429); suspending requests for 60s+")
+        return resp
+
+    # ------------------------------------------------------------ high level
+
+    async def check_key(self) -> bool:
+        """GET /key (bearer no-op) with legacy GET /key/{key} fallback
+        (reference: src/api.rs:560-612)."""
+        resp = await self._request("GET", self.endpoint.join("key"))
+        if resp.status == 200:
+            return True
+        if resp.status == 404 and self.key:
+            legacy = await self._request("GET", self.endpoint.join(f"key/{self.key}"))
+            return legacy.status == 200
+        return False
+
+    async def status(self) -> Optional[QueueStatus]:
+        resp = await self._request("GET", self.endpoint.join("status"))
+        if resp.status != 200:
+            return None
+        try:
+            obj = resp.json()
+            return QueueStatus(
+                user_oldest=float(obj["analysis"]["user"].get("oldest", 0)),
+                system_oldest=float(obj["analysis"]["system"].get("oldest", 0)),
+            )
+        except (ValueError, KeyError):
+            return None
+
+    async def acquire(self, slow: bool) -> Acquired:
+        url = self.endpoint.join("acquire") + ("?slow=true" if slow else "")
+        resp = await self._request("POST", url, self._fishnet_body())
+        if resp.status in (200, 202):
+            self.backoff.reset()
+            return Acquired(AcquiredKind.ACCEPTED, AcquireResponseBody.from_json(resp.json()))
+        if resp.status == 204:
+            return Acquired(AcquiredKind.NO_CONTENT)
+        if resp.status in (400, 401, 403, 406):
+            # server-driven kill switch (reference: src/api.rs:653-663)
+            return Acquired(AcquiredKind.REJECTED)
+        raise ApiError(resp.status, "acquire")
+
+    async def submit_analysis(
+        self, batch_id: str, flavor: EvalFlavor, analysis: List[Optional[dict]]
+    ) -> None:
+        url = self.endpoint.join(f"analysis/{batch_id}") + "?stop=true"
+        body = dict(self._fishnet_body())
+        body["stockfish"] = {"flavor": flavor.to_json()}
+        body["analysis"] = analysis
+        resp = await self._request("POST", url, body)
+        if resp.status >= 300:
+            raise ApiError(resp.status, "submit analysis")
+
+    async def submit_move_and_acquire(
+        self, batch_id: str, best_move: Optional[str]
+    ) -> Optional[Acquired]:
+        """POST /move/{id}; a 202 response chains the next job directly
+        without an /acquire round trip (reference: src/api.rs:710-751)."""
+        url = self.endpoint.join(f"move/{batch_id}")
+        body = dict(self._fishnet_body())
+        body["move"] = {"bestmove": best_move}
+        resp = await self._request("POST", url, body)
+        if resp.status == 202:
+            return Acquired(AcquiredKind.ACCEPTED, AcquireResponseBody.from_json(resp.json()))
+        if resp.status < 300:
+            return Acquired(AcquiredKind.NO_CONTENT)
+        raise ApiError(resp.status, "submit move")
+
+    async def abort(self, batch_id: str) -> None:
+        """Hand a job back on shutdown (reference: src/api.rs:537-558)."""
+        url = self.endpoint.join(f"abort/{batch_id}")
+        resp = await self._request("POST", url, self._fishnet_body())
+        if resp.status == 404:
+            return  # abort not supported by this server
+        if resp.status >= 300:
+            raise ApiError(resp.status, "abort")

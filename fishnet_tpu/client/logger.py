@@ -1,0 +1,110 @@
+"""Logger and progress UI (reference: src/logger.rs:19-213).
+
+Level prefixes (`D:`, `W:`, `E:`, and the `><> ` fishnet headline), an
+in-place `\\r` progress line on TTYs, the ASCII queue gauge
+`[===  |=  ]` of pending-positions-vs-cores, and deep links into games
+(`https://lichess.org/{game}#{ply}`).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+# short variant names for the progress line (reference: src/logger.rs:201-213)
+SHORT_VARIANT_NAMES = {
+    "standard": None,
+    "fromPosition": None,
+    "chess960": "960",
+    "antichess": "anti",
+    "atomic": "atomic",
+    "crazyhouse": "zh",
+    "horde": "horde",
+    "kingOfTheHill": "koth",
+    "racingKings": "race",
+    "threeCheck": "3check",
+}
+
+
+def short_variant_name(variant: str) -> Optional[str]:
+    return SHORT_VARIANT_NAMES.get(variant, variant)
+
+
+@dataclass
+class ProgressAt:
+    batch_id: str
+    batch_url: Optional[str]
+    position_index: Optional[int]
+
+    def __str__(self) -> str:
+        if self.batch_url:
+            frag = f"#{self.position_index}" if self.position_index is not None else ""
+            return f"{self.batch_url}{frag}"
+        return f"batch {self.batch_id}"
+
+
+@dataclass
+class QueueStatusBar:
+    """`[===  |=  ]`: filled to pending positions, bar at cores."""
+
+    pending: int
+    cores: int
+
+    def __str__(self) -> str:
+        width = max(self.cores, 1)
+        total = max(self.pending, 0)
+        inside = min(total, width)
+        overflow = total - inside
+        bar = "=" * inside + " " * (width - inside)
+        s = f"[{bar}|{'=' * min(overflow, width)}{' ' * max(0, width - overflow)}]"
+        return s
+
+
+class Logger:
+    """Verbosity-gated logger; progress lines rewrite in place on a TTY."""
+
+    def __init__(self, verbose: int = 0, stream=None) -> None:
+        self.verbose = verbose
+        self.stream = stream or sys.stdout
+        self._lock = threading.Lock()
+        self._progress_line_len = 0
+
+    def _clear_progress(self) -> None:
+        if self._progress_line_len:
+            self.stream.write("\r" + " " * self._progress_line_len + "\r")
+            self._progress_line_len = 0
+
+    def _emit(self, line: str) -> None:
+        with self._lock:
+            self._clear_progress()
+            self.stream.write(line + "\n")
+            self.stream.flush()
+
+    def headline(self, text: str) -> None:
+        self._emit(f"><> {text}")
+
+    def info(self, text: str) -> None:
+        self._emit(text)
+
+    def debug(self, text: str) -> None:
+        if self.verbose > 0:
+            self._emit(f"D: {text}")
+
+    def warn(self, text: str) -> None:
+        self._emit(f"W: {text}")
+
+    def error(self, text: str) -> None:
+        self._emit(f"E: {text}")
+
+    def progress(self, status_bar, progress_at) -> None:
+        line = f"{status_bar} {progress_at}"
+        with self._lock:
+            if self.stream.isatty():
+                pad = max(0, self._progress_line_len - len(line))
+                self.stream.write("\r" + line + " " * pad)
+                self.stream.flush()
+                self._progress_line_len = len(line)
+            elif self.verbose > 0:
+                self.stream.write(line + "\n")
+                self.stream.flush()

@@ -1,0 +1,364 @@
+"""Central scheduler: acquire → plan → dispatch → reassemble → submit.
+
+Asyncio re-design of the reference's queue actor (reference:
+src/queue.rs:37-522). Workers call `pull(responses)`: completed chunk
+results are folded into pending batches, then the next chunk is handed out;
+if none is queued, the puller drives the acquire loop (backlog-aware idling,
+randomized backoff on empty polls, move-job chaining, kill-switch on
+rejection). Single event loop replaces the actor mailboxes; state needs no
+lock beyond the acquire critical section.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List, Optional, Set, Union
+
+from .api import Acquired, AcquiredKind, ApiClient, ApiError
+from .backoff import RandomizedBackoff
+from .ipc import Chunk, ChunkFailed, PositionResponse
+from .logger import Logger, ProgressAt, QueueStatusBar, short_variant_name
+from .planner import (
+    SKIP,
+    AllSkipped,
+    IncomingBatch,
+    IncomingError,
+    PendingBatch,
+)
+from .stats import StatsRecorder
+from .wire import AnalysisWork, EvalFlavor, MoveWork
+
+
+class ShuttingDown(Exception):
+    """Raised from pull() when the queue drains for shutdown."""
+
+
+@dataclass
+class BacklogOpt:
+    """Minimum queue ages before acquiring (reference: src/configure.rs:244-289:
+    Short=30s, Long=1h, or an explicit duration)."""
+
+    user: Optional[float] = None
+    system: Optional[float] = None
+
+    SHORT = 30.0
+    LONG = 3600.0
+
+
+@dataclass
+class MoveSubmission:
+    batch_id: str
+    best_move: Optional[str]
+
+
+class Queue:
+    def __init__(
+        self,
+        api: ApiClient,
+        cores: int,
+        backlog: Optional[BacklogOpt] = None,
+        stats: Optional[StatsRecorder] = None,
+        logger: Optional[Logger] = None,
+        tpu_variants: Optional[Set[str]] = None,
+        tpu_moves: bool = False,
+        max_backoff_s: float = 30.0,
+    ) -> None:
+        self.api = api
+        self.cores = cores
+        self.backlog = backlog or BacklogOpt()
+        self.stats = stats or StatsRecorder(no_stats_file=True, cores=cores)
+        self.logger = logger or Logger()
+        self.tpu_variants = tpu_variants
+        self.tpu_moves = tpu_moves
+
+        self.incoming: Deque[Chunk] = deque()
+        self.pending: Dict[str, PendingBatch] = {}
+        self.move_submissions: Deque[MoveSubmission] = deque()
+        self.shutdown_soon = False
+        self.backoff = RandomizedBackoff(max_backoff_s)
+        self._acquire_lock = asyncio.Lock()
+        self._interrupt = asyncio.Event()
+        self._submit_tasks: Set[asyncio.Task] = set()
+
+    # -------------------------------------------------------------- plumbing
+
+    def status_bar(self) -> QueueStatusBar:
+        return QueueStatusBar(
+            pending=sum(p.pending() for p in self.pending.values()),
+            cores=self.cores,
+        )
+
+    def _spawn_submit(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._submit_tasks.add(task)
+        task.add_done_callback(self._submit_tasks.discard)
+
+    async def _safe_submit_analysis(self, batch_id, flavor, analysis) -> None:
+        try:
+            await self.api.submit_analysis(batch_id, flavor, analysis)
+        except ApiError as e:
+            self.logger.error(f"Failed to submit analysis for {batch_id}: {e}")
+
+    # -------------------------------------------------------- state handling
+
+    def add_incoming_batch(self, batch: IncomingBatch) -> None:
+        """(reference: src/queue.rs:155-189)"""
+        batch_id = batch.work.id
+        if batch_id in self.pending:
+            self.logger.error(f"Dropping duplicate incoming batch {batch_id}")
+            return
+        positions: List[object] = []
+        for chunk in batch.chunks:
+            for pos in chunk.positions:
+                if pos.position_index is None:
+                    continue
+                while len(positions) <= pos.position_index:
+                    positions.append(SKIP)  # gaps = skipped plies
+                positions[pos.position_index] = SKIP if pos.skip else None
+            self.incoming.append(chunk)
+        self.pending[batch_id] = PendingBatch(
+            work=batch.work,
+            url=batch.url,
+            flavor=batch.flavor,
+            variant=batch.variant,
+            positions=positions,
+        )
+        self.logger.progress(
+            self.status_bar(), ProgressAt(batch_id, batch.url, None)
+        )
+
+    def handle_position_responses(
+        self, responses: Union[List[PositionResponse], ChunkFailed, None]
+    ) -> None:
+        """(reference: src/queue.rs:191-234)"""
+        if responses is None:
+            return
+        if isinstance(responses, ChunkFailed):
+            # forget the batch; the server will re-queue it by timeout
+            self.pending.pop(responses.batch_id, None)
+            self.incoming = deque(
+                c for c in self.incoming if c.work.id != responses.batch_id
+            )
+            return
+        progress_at = None
+        batch_ids: List[str] = []
+        for res in responses:
+            pending = self.pending.get(res.work.id)
+            if pending is None:
+                continue
+            pending.total_nodes += res.nodes
+            pending.total_cpu_time += res.time_s
+            if res.position_index is None:
+                continue  # discarded overlap position
+            if res.position_index >= len(pending.positions):
+                continue
+            progress_at = ProgressAt(res.work.id, res.url, res.position_index)
+            pending.positions[res.position_index] = res
+            if res.work.id not in batch_ids:
+                batch_ids.append(res.work.id)
+        if progress_at is not None:
+            self.logger.progress(self.status_bar(), progress_at)
+        for batch_id in batch_ids:
+            self.maybe_finished(batch_id)
+
+    def maybe_finished(self, batch_id: str) -> None:
+        """(reference: src/queue.rs:247-319)"""
+        pending = self.pending.pop(batch_id, None)
+        if pending is None:
+            return
+        completed = pending.try_into_completed()
+        if completed is None:
+            if not pending.work.matrix_wanted():
+                # stream partial analysis as a progress report
+                self._spawn_submit(
+                    self._safe_submit_analysis(
+                        pending.work.id,
+                        pending.flavor.eval_flavor(),
+                        pending.progress_report(),
+                    )
+                )
+            self.pending[batch_id] = pending
+            return
+
+        extra = []
+        sv = short_variant_name(completed.variant)
+        if sv:
+            extra.append(sv)
+        if completed.flavor.eval_flavor() is EvalFlavor.HCE:
+            extra.append("hce")
+        nps = completed.nps()
+        if nps is not None:
+            nnue_nps = nps if completed.flavor.eval_flavor() is EvalFlavor.NNUE else None
+            self.stats.record_batch(
+                completed.total_positions(), completed.total_nodes, nnue_nps
+            )
+            extra.append(f"{nps // 1000} knps/core")
+        else:
+            extra.append("? nps")
+        where = completed.url or f"batch {batch_id}"
+        log_line = f"{self.status_bar()} {where} finished ({', '.join(extra)})"
+
+        if isinstance(completed.work, AnalysisWork):
+            self.logger.info(log_line)
+            self._spawn_submit(
+                self._safe_submit_analysis(
+                    completed.work.id,
+                    completed.flavor.eval_flavor(),
+                    completed.into_analysis(),
+                )
+            )
+        else:
+            self.logger.debug(log_line)
+            self.move_submissions.append(
+                MoveSubmission(completed.work.id, completed.into_best_move())
+            )
+            self._interrupt.set()
+
+    # --------------------------------------------------------- acquire logic
+
+    async def _backlog_wait_time(self) -> tuple:
+        """(reference: src/queue.rs:350-390)"""
+        user_backlog = max(
+            self.stats.min_user_backlog(), self.backlog.user or 0.0
+        )
+        system_backlog = self.backlog.system or 0.0
+        if user_backlog >= 1.0 or system_backlog >= 1.0:
+            status = await self.api.status()
+            if status is not None:
+                user_wait = max(0.0, user_backlog - status.user_oldest)
+                system_wait = max(0.0, system_backlog - status.system_oldest)
+                slow = user_wait >= system_wait + 1.0
+                return (min(user_wait, system_wait), slow)
+            slow = user_backlog >= system_backlog + 1.0
+            return (0.0, slow)
+        return (0.0, False)
+
+    async def handle_acquired_response_body(self, body) -> None:
+        """(reference: src/queue.rs:392-429)"""
+        batch_id = body.work.id
+        try:
+            incoming = IncomingBatch.from_acquired(
+                str(self.api.endpoint),
+                body,
+                tpu_variants=self.tpu_variants,
+                tpu_moves=self.tpu_moves,
+            )
+        except AllSkipped as all_skipped:
+            completed = all_skipped.completed
+            self.logger.warn(f"Completed empty batch {batch_id}.")
+            self._spawn_submit(
+                self._safe_submit_analysis(
+                    completed.work.id,
+                    completed.flavor.eval_flavor(),
+                    completed.into_analysis(),
+                )
+            )
+            return
+        except IncomingError as err:
+            if body.work.is_move:
+                self.logger.warn(f"Invalid move request {batch_id}: {err}")
+                self.move_submissions.append(MoveSubmission(batch_id, None))
+                self._interrupt.set()
+            else:
+                self.logger.warn(f"Ignoring invalid batch {batch_id}: {err}")
+            return
+        self.add_incoming_batch(incoming)
+
+    async def _handle_move_submissions(self) -> None:
+        """(reference: src/queue.rs:431-457)"""
+        while not self.shutdown_soon and self.move_submissions:
+            sub = self.move_submissions.popleft()
+            try:
+                acquired = await self.api.submit_move_and_acquire(
+                    sub.batch_id, sub.best_move
+                )
+            except ApiError as e:
+                self.logger.error(f"Failed to submit move for {sub.batch_id}: {e}")
+                continue
+            if acquired and acquired.kind == AcquiredKind.ACCEPTED and acquired.body:
+                await self.handle_acquired_response_body(acquired.body)
+
+    async def _interruptible_sleep(self, delay: float) -> None:
+        try:
+            await asyncio.wait_for(self._interrupt.wait(), timeout=delay)
+            self._interrupt.clear()
+        except asyncio.TimeoutError:
+            pass
+
+    async def pull(
+        self, responses: Union[List[PositionResponse], ChunkFailed, None]
+    ) -> Chunk:
+        """Fold in finished work, then obtain the next chunk; the calling
+        worker drives acquisition when the queue is empty
+        (reference: src/queue.rs:459-522 + main.rs:237-243)."""
+        self.handle_position_responses(responses)
+        while True:
+            await self._handle_move_submissions()
+            if self.incoming:
+                return self.incoming.popleft()
+            if self.shutdown_soon:
+                raise ShuttingDown()
+
+            async with self._acquire_lock:
+                if self.incoming or self.shutdown_soon:
+                    continue  # another worker already acquired
+
+                wait, slow = await self._backlog_wait_time()
+                if wait >= 1.0:
+                    level = self.logger.info if wait >= 40.0 else self.logger.debug
+                    level(f"Going idle for {wait:.0f}s.")
+                    await self._interruptible_sleep(wait)
+                    continue
+
+                try:
+                    acquired = await self.api.acquire(slow)
+                except ApiError:
+                    continue  # backoff already applied inside the client
+                if acquired.kind == AcquiredKind.ACCEPTED and acquired.body:
+                    self.backoff.reset()
+                    await self.handle_acquired_response_body(acquired.body)
+                elif acquired.kind == AcquiredKind.NO_CONTENT:
+                    delay = self.backoff.next()
+                    self.logger.debug(f"No job received. Backing off {delay:.1f}s.")
+                    await self._interruptible_sleep(delay)
+                elif acquired.kind == AcquiredKind.REJECTED:
+                    self.logger.error(
+                        "Client update or reconfiguration might be required."
+                        " Stopping queue."
+                    )
+                    self.shutdown_soon = True
+
+    # -------------------------------------------------------------- shutdown
+
+    def stop_acquiring(self) -> None:
+        self.shutdown_soon = True
+        self._interrupt.set()
+
+    async def shutdown(self) -> None:
+        """Abort all pending batches so the server reassigns them immediately
+        (reference: src/queue.rs:107-114, src/api.rs:537-558)."""
+        self.shutdown_soon = True
+        self._interrupt.set()
+        for batch_id in list(self.pending):
+            self.pending.pop(batch_id, None)
+            try:
+                await self.api.abort(batch_id)
+            except ApiError as e:
+                self.logger.warn(f"Failed to abort {batch_id}: {e}")
+        self.incoming.clear()
+        if self._submit_tasks:
+            await asyncio.gather(*list(self._submit_tasks), return_exceptions=True)
+
+    async def drain_submissions(self) -> None:
+        if self._submit_tasks:
+            await asyncio.gather(*list(self._submit_tasks), return_exceptions=True)
+
+    def stats_summary(self) -> str:
+        """The 120 s summary line (reference: src/main.rs:202-214)."""
+        s = self.stats.stats
+        return (
+            f"{self.stats.nnue_nps} (nnue), {s.total_batches} batches, "
+            f"{s.total_positions} positions, {s.total_nodes} nodes"
+        )

@@ -1,0 +1,130 @@
+"""Lifetime stats, NPS estimation, and the SQLite time-series sink.
+
+Parity with the reference's StatsRecorder (reference: src/stats.rs:21-231):
+JSON counters persisted to ~/.fishnet-stats, NNUE NPS EWMA (α=0.9, seeded
+400 knps, uncertainty decay), plus the fork-added SQLite sink (stats.db;
+reference: src/stats.rs:158-194 — implemented there against a missing
+rusqlite dependency, done here with the stdlib sqlite3 module). Also restores
+`min_user_backlog`, which the fork deleted but the queue's backlog logic
+requires (call site in reference: src/queue.rs:350-361; intent documented in
+reference README.md:83-87 — clients slower than the admission target
+self-select out of user-facing work).
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Optional
+
+
+@dataclass
+class Stats:
+    total_batches: int = 0
+    total_positions: int = 0
+    total_nodes: int = 0
+
+
+class NpsRecorder:
+    """EWMA of observed NNUE nodes/sec with decaying uncertainty."""
+
+    def __init__(self, seed_nps: int = 400_000) -> None:
+        self.nps = seed_nps  # optimistic prior (reference: src/stats.rs:206)
+        self.uncertainty = 1.0
+
+    def record(self, nps: int) -> None:
+        alpha = 0.9
+        self.uncertainty *= alpha
+        self.nps = int(self.nps * alpha + nps * (1.0 - alpha))
+
+    def __str__(self) -> str:
+        s = f"{self.nps // 1000} knps/core"
+        for threshold in (0.1, 0.4, 0.7):
+            if self.uncertainty > threshold:
+                s += "?" if s.endswith("?") else " ?"
+        return s
+
+
+class StatsRecorder:
+    def __init__(
+        self,
+        stats_file: Optional[Path] = None,
+        no_stats_file: bool = False,
+        db_file: Optional[Path] = None,
+        cores: int = 1,
+    ) -> None:
+        self.cores = cores
+        self.nnue_nps = NpsRecorder()
+        self.stats = Stats()
+        self._path: Optional[Path] = None
+        self._db: Optional[sqlite3.Connection] = None
+
+        if not no_stats_file:
+            self._path = stats_file or (Path.home() / ".fishnet-stats")
+            try:
+                if self._path.exists() and self._path.stat().st_size > 0:
+                    self.stats = Stats(**json.loads(self._path.read_text()))
+            except (OSError, ValueError, TypeError):
+                self.stats = Stats()
+            if db_file is not None:
+                try:
+                    self._db = sqlite3.connect(str(db_file))
+                    self._db.execute(
+                        "CREATE TABLE IF NOT EXISTS stats ("
+                        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                        " timestamp INTEGER NOT NULL,"
+                        " total_batches INTEGER NOT NULL,"
+                        " total_positions INTEGER NOT NULL,"
+                        " total_nodes INTEGER NOT NULL,"
+                        " nnue_nps INTEGER NOT NULL)"
+                    )
+                    self._db.commit()
+                except sqlite3.Error:
+                    self._db = None
+
+    def record_batch(self, positions: int, nodes: int, nnue_nps: Optional[int]) -> None:
+        self.stats.total_batches += 1
+        self.stats.total_positions += positions
+        self.stats.total_nodes += nodes
+        if nnue_nps is not None:
+            self.nnue_nps.record(nnue_nps)
+        if self._path is not None:
+            try:
+                self._path.write_text(json.dumps(asdict(self.stats), indent=2))
+            except OSError:
+                pass
+        if self._db is not None:
+            try:
+                self._db.execute(
+                    "INSERT INTO stats (timestamp, total_batches, total_positions,"
+                    " total_nodes, nnue_nps) VALUES (?, ?, ?, ?, ?)",
+                    (
+                        int(time.time()),
+                        self.stats.total_batches,
+                        self.stats.total_positions,
+                        self.stats.total_nodes,
+                        nnue_nps or 0,
+                    ),
+                )
+                self._db.commit()
+            except sqlite3.Error:
+                pass
+
+    def min_user_backlog(self) -> float:
+        """Seconds of user-queue backlog below which this client should not
+        take user-facing jobs: clients slower than the ~2 Mnodes / 6 s
+        admission target (reference README.md:83-87) wait until the user
+        queue has aged. A typical batch is ~60 positions × ~2.25 Mnodes;
+        top-end clients clear it in ~35 s.
+        """
+        best_batch_seconds = 35.0
+        typical_batch_nodes = 60 * 2_250_000
+        batch_seconds = typical_batch_nodes / max(self.nnue_nps.nps, 1)
+        return max(0.0, batch_seconds - best_batch_seconds)
+
+    def close(self) -> None:
+        if self._db is not None:
+            self._db.close()
+            self._db = None

@@ -1,0 +1,96 @@
+"""Worker loop: races engine execution against chunk deadlines.
+
+Parity with the reference's per-core worker (reference: src/main.rs:263-390):
+one engine instance per flavor kept warm, deadline race with engine kill on
+overrun, drop-and-respawn with randomized backoff on engine errors, and
+ChunkFailed reporting so the queue forgets the batch.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Union
+
+from ..engine.base import Engine, EngineError
+from .backoff import RandomizedBackoff
+from .ipc import Chunk, ChunkFailed, PositionResponse
+from .logger import Logger
+from .queue import Queue, ShuttingDown
+
+
+async def worker(
+    index: int,
+    queue: Queue,
+    engine_factory,
+    logger: Optional[Logger] = None,
+) -> None:
+    logger = logger or Logger()
+    engines: Dict[object, Engine] = {}
+    backoffs: Dict[object, RandomizedBackoff] = {}
+    responses: Union[List[PositionResponse], ChunkFailed, None] = None
+
+    try:
+        while True:
+            try:
+                chunk = await queue.pull(responses)
+            except ShuttingDown:
+                break
+            responses = None
+            flavor = chunk.flavor
+
+            engine = engines.get(flavor)
+            if engine is None:
+                backoff = backoffs.setdefault(flavor, RandomizedBackoff())
+                if backoff._last_ms:
+                    delay = backoff.next()
+                    logger.warn(
+                        f"Worker {index} waiting {delay:.1f}s before restarting"
+                        f" {flavor.value} engine"
+                    )
+                    await asyncio.sleep(delay)
+                try:
+                    engine = engine_factory(flavor)
+                except Exception as e:
+                    logger.error(f"Worker {index} failed to start engine: {e}")
+                    backoffs[flavor].next()
+                    responses = ChunkFailed(chunk.work.id)
+                    continue
+                engines[flavor] = engine
+
+            timeout = chunk.deadline - time.monotonic()
+            if timeout <= 0:
+                logger.warn(f"Worker {index} got chunk past its deadline")
+                responses = ChunkFailed(chunk.work.id)
+                continue
+            try:
+                responses = await asyncio.wait_for(
+                    engine.go_multiple(chunk), timeout=timeout
+                )
+                backoffs.get(flavor, RandomizedBackoff()).reset()
+            except asyncio.TimeoutError:
+                logger.warn(
+                    f"Worker {index} chunk of batch {chunk.work.id} timed out;"
+                    " dropping engine"
+                )
+                await _drop_engine(engines, flavor)
+                responses = ChunkFailed(chunk.work.id)
+            except EngineError as e:
+                logger.error(f"Worker {index} engine error: {e}; dropping engine")
+                await _drop_engine(engines, flavor)
+                backoffs.setdefault(flavor, RandomizedBackoff()).next()
+                responses = ChunkFailed(chunk.work.id)
+    finally:
+        for engine in engines.values():
+            try:
+                await engine.close()
+            except Exception:
+                pass
+
+
+async def _drop_engine(engines: Dict, flavor) -> None:
+    engine = engines.pop(flavor, None)
+    if engine is not None:
+        try:
+            await engine.close()
+        except Exception:
+            pass

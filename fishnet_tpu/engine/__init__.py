@@ -1,0 +1,4 @@
+"""Engine backends: TPU (JAX/Pallas), UCI subprocess, and pure-Python CPU."""
+from .base import Engine, EngineError, EngineFactory
+
+__all__ = ["Engine", "EngineError", "EngineFactory"]

@@ -1,0 +1,31 @@
+"""Engine interface: everything behind `go_multiple(Chunk)`.
+
+The reference keeps Stockfish subprocesses behind exactly this shape
+(reference: src/stockfish.rs:36-48 `StockfishStub::go_multiple`); here it is
+the seam between the client framework and the three backends (TPU batch
+engine, UCI subprocess, pure-Python fallback).
+"""
+from __future__ import annotations
+
+from typing import List, Protocol
+
+from ..client.ipc import Chunk, PositionResponse
+
+
+class EngineError(Exception):
+    """Engine died or misbehaved; the worker drops and respawns it with
+    backoff (reference: src/main.rs:330-336)."""
+
+
+class Engine(Protocol):
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        """Analyse every position of the chunk, in order."""
+        ...
+
+    async def close(self) -> None:
+        ...
+
+
+class EngineFactory(Protocol):
+    def __call__(self, flavor) -> Engine:
+        ...

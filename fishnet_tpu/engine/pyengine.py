@@ -1,0 +1,205 @@
+"""Pure-Python fallback engine.
+
+Plays the role of the bundled engines for environments with neither TPU nor
+an external UCI binary: a small iterative-deepening negamax with material +
+mobility evaluation over the host rules library. It exists for functional
+completeness and as a pipeline oracle — the TPU engine is the performance
+path. Engine surface mirrors the reference's per-chunk dialogue
+(reference: src/stockfish.rs:222-288): one response per position, scores
+and PVs accumulated per depth into multipv×depth matrices.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import List, Optional, Tuple
+
+from ..chess.position import Position
+from ..chess.types import BISHOP, KNIGHT, PAWN, QUEEN, ROOK
+from ..chess.variants import from_fen
+from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
+from ..client.wire import AnalysisWork, MoveWork, Score
+
+MATE_VALUE = 32000
+PIECE_VALUES = {PAWN: 100, KNIGHT: 300, BISHOP: 315, ROOK: 500, QUEEN: 900, 5: 0}
+
+
+class SearchBudgetExceeded(Exception):
+    pass
+
+
+class PySearch:
+    def __init__(self, node_budget: Optional[int] = None):
+        self.nodes = 0
+        self.node_budget = node_budget
+
+    def evaluate(self, pos: Position) -> int:
+        """Material + mobility, from the side to move's perspective."""
+        us = pos.turn
+        score = 0
+        for ptype, val in PIECE_VALUES.items():
+            score += val * (
+                bin(pos.bbs[us][ptype]).count("1")
+                - bin(pos.bbs[us ^ 1][ptype]).count("1")
+            )
+        score += 2 * len(pos.legal_moves())
+        return score
+
+    def _ordered_moves(self, pos: Position):
+        moves = pos.legal_moves()
+        them_occ = pos.occ[pos.turn ^ 1]
+        moves.sort(key=lambda m: 0 if (1 << m.to_sq) & them_occ else 1)
+        return moves
+
+    def negamax(
+        self, pos: Position, depth: int, alpha: int, beta: int, ply: int
+    ) -> Tuple[int, List[str]]:
+        self.nodes += 1
+        if self.node_budget is not None and self.nodes > self.node_budget:
+            raise SearchBudgetExceeded()
+        moves = self._ordered_moves(pos)
+        outcome = pos.outcome(moves)
+        if outcome is not None:
+            winner, _reason = outcome
+            if winner is None:
+                return 0, []
+            # a decided game means the side to move lost (checkmate/variant
+            # loss) unless the variant outcome says the mover won
+            return (
+                (MATE_VALUE - ply) if winner == pos.turn else -(MATE_VALUE - ply)
+            ), []
+        if depth <= 0:
+            return self.evaluate(pos), []
+        best_line: List[str] = []
+        best = -MATE_VALUE * 2
+        for move in moves:
+            child = pos.push(move)
+            score, line = self.negamax(child, depth - 1, -beta, -alpha, ply + 1)
+            score = -score
+            if score > best:
+                best = score
+                best_line = [move.uci()] + line
+            alpha = max(alpha, score)
+            if alpha >= beta:
+                break
+        return best, best_line
+
+
+def _score_of(value: int, ply_base: int = 0) -> Score:
+    if value >= MATE_VALUE - 1000:
+        return Score.mate((MATE_VALUE - value + 1) // 2)
+    if value <= -(MATE_VALUE - 1000):
+        return Score.mate(-((MATE_VALUE + value + 1) // 2))
+    return Score.cp(value)
+
+
+class PyEngine:
+    """Analyses chunks synchronously on the executor."""
+
+    def __init__(self, max_depth: int = 3, multipv_max: int = 5):
+        self.max_depth = max_depth
+        self.multipv_max = multipv_max
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._go_multiple_sync, chunk)
+
+    async def close(self) -> None:
+        pass
+
+    def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
+        return [self._analyse(chunk, pos) for pos in chunk.positions]
+
+    def _analyse(self, chunk: Chunk, wp: WorkPosition) -> PositionResponse:
+        started = time.monotonic()
+        pos = from_fen(wp.root_fen, chunk.variant)
+        for uci in wp.moves:
+            pos = pos.push(pos.parse_uci(uci))
+
+        work = chunk.work
+        if isinstance(work, AnalysisWork):
+            target_depth = min(work.depth or self.max_depth, self.max_depth)
+            multipv = min(work.effective_multipv(), self.multipv_max)
+            node_budget = work.nodes.get(chunk.flavor.eval_flavor())
+        else:
+            assert isinstance(work, MoveWork)
+            target_depth = min(work.level.depth, self.max_depth)
+            multipv = 1
+            node_budget = None
+
+        scores = Matrix()
+        pvs = Matrix()
+        search = PySearch(node_budget)
+        best_move: Optional[str] = None
+
+        outcome = pos.outcome()
+        if outcome is not None:
+            winner, _ = outcome
+            if winner is None:
+                score = Score.cp(0)
+            else:
+                score = Score.mate(0)
+            scores.set(1, 0, score)
+            pvs.set(1, 0, [])
+            return PositionResponse(
+                work=work,
+                position_index=wp.position_index,
+                url=wp.url,
+                scores=scores,
+                pvs=pvs,
+                best_move=None,
+                depth=0,
+                nodes=search.nodes,
+                time_s=time.monotonic() - started,
+            )
+
+        reached_depth = 0
+        root_scored: List[Tuple[int, str, List[str]]] = []
+        try:
+            for depth in range(1, target_depth + 1):
+                moves = search._ordered_moves(pos)
+                depth_scored = []
+                for move in moves:
+                    child = pos.push(move)
+                    value, line = search.negamax(
+                        child, depth - 1, -MATE_VALUE * 2, MATE_VALUE * 2, 1
+                    )
+                    depth_scored.append((-value, move.uci(), [move.uci()] + line))
+                depth_scored.sort(key=lambda t: -t[0])
+                root_scored = depth_scored
+                reached_depth = depth
+                for rank, (value, _uci, line) in enumerate(
+                    depth_scored[:multipv], start=1
+                ):
+                    scores.set(rank, depth, _score_of(value))
+                    pvs.set(rank, depth, line)
+        except SearchBudgetExceeded:
+            pass
+
+        if root_scored:
+            best_move = self._pick_move(work, root_scored)
+        elapsed = max(time.monotonic() - started, 1e-6)
+        return PositionResponse(
+            work=work,
+            position_index=wp.position_index,
+            url=wp.url,
+            scores=scores,
+            pvs=pvs,
+            best_move=best_move,
+            depth=reached_depth,
+            nodes=search.nodes,
+            time_s=elapsed,
+            nps=int(search.nodes / elapsed),
+        )
+
+    def _pick_move(self, work, root_scored) -> str:
+        """Move jobs weaken play below max level by sampling near-best moves
+        (the reference delegates this to Stockfish's Skill Level option —
+        src/stockfish.rs:261-277; here it is approximated directly)."""
+        if isinstance(work, MoveWork) and work.level.level < 8:
+            margin = (9 - work.level.level) * 30
+            best_value = root_scored[0][0]
+            candidates = [t for t in root_scored if t[0] >= best_value - margin]
+            return random.choice(candidates)[1]
+        return root_scored[0][1]

@@ -1,0 +1,139 @@
+"""In-process fake lichess server implementing the fishnet protocol
+(doc/protocol.md) for integration tests: acquire/analysis/move/abort/status/
+key over localhost HTTP."""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeLichess:
+    def __init__(self, key: str = "testkey", with_status: bool = True):
+        self.key = key
+        self.with_status = with_status
+        self.jobs = deque()
+        self.analyses = {}  # work_id -> list of submitted analysis bodies
+        self.moves = {}  # work_id -> submitted move bodies
+        self.aborted = []
+        self.acquire_count = 0
+        self.status_body = {
+            "analysis": {
+                "user": {"acquired": 1, "queued": 0, "oldest": 0},
+                "system": {"acquired": 0, "queued": 0, "oldest": 0},
+            }
+        }
+        self.lock = threading.Lock()
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), self._make_handler())
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        host, port = self.server.server_address
+        return f"http://{host}:{port}/fishnet"
+
+    def start(self):
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def add_analysis_job(self, job_id, position, moves, variant="standard",
+                         skip=(), nodes=None, multipv=None, timeout_ms=7000):
+        self.jobs.append({
+            "work": {
+                "type": "analysis",
+                "id": job_id,
+                "nodes": nodes or {"sf16": 1500000, "classical": 4050000},
+                "timeout": timeout_ms,
+                **({"multipv": multipv} if multipv else {}),
+            },
+            "game_id": job_id,
+            "position": position,
+            "variant": variant,
+            "moves": " ".join(moves),
+            "skipPositions": list(skip),
+        })
+
+    def add_move_job(self, job_id, position, moves, level=5, variant="standard"):
+        self.jobs.append({
+            "work": {"type": "move", "id": job_id, "level": level},
+            "game_id": job_id,
+            "position": position,
+            "variant": variant,
+            "moves": " ".join(moves),
+        })
+
+    def _make_handler(server_self):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, body=None):
+                self.send_response(status)
+                if body is not None:
+                    payload = json.dumps(body).encode()
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+            def _read_body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    return json.loads(raw) if raw else {}
+                except ValueError:
+                    return {}
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/fishnet/status":
+                    if server_self.with_status:
+                        self._reply(200, server_self.status_body)
+                    else:
+                        self._reply(404)
+                elif path == "/fishnet/key":
+                    auth = self.headers.get("Authorization", "")
+                    ok = auth == f"Bearer {server_self.key}"
+                    self._reply(200 if ok else 404)
+                elif path.startswith("/fishnet/key/"):
+                    ok = path.rsplit("/", 1)[1] == server_self.key
+                    self._reply(200 if ok else 404)
+                else:
+                    self._reply(404)
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                body = self._read_body()
+                with server_self.lock:
+                    if path == "/fishnet/acquire":
+                        server_self.acquire_count += 1
+                        if server_self.jobs:
+                            self._reply(202, server_self.jobs.popleft())
+                        else:
+                            self._reply(204)
+                    elif path.startswith("/fishnet/analysis/"):
+                        work_id = path.rsplit("/", 1)[1]
+                        server_self.analyses.setdefault(work_id, []).append(body)
+                        self._reply(204)
+                    elif path.startswith("/fishnet/move/"):
+                        work_id = path.rsplit("/", 1)[1]
+                        server_self.moves[work_id] = body
+                        if server_self.jobs:
+                            self._reply(202, server_self.jobs.popleft())
+                        else:
+                            self._reply(204)
+                    elif path.startswith("/fishnet/abort/"):
+                        server_self.aborted.append(path.rsplit("/", 1)[1])
+                        self._reply(204)
+                    else:
+                        self._reply(404)
+
+        return Handler

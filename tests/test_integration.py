@@ -1,0 +1,148 @@
+"""End-to-end client integration tests against the fake lichess server:
+acquire → plan → workers/engine → reassemble → submit."""
+import asyncio
+
+import pytest
+
+from fishnet_tpu.client.api import ApiClient, Endpoint
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.queue import BacklogOpt, Queue
+from fishnet_tpu.client.stats import StatsRecorder
+from fishnet_tpu.client.workers import worker
+from fishnet_tpu.engine.pyengine import PyEngine
+
+from fake_server import FakeLichess
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def run_client_until(server, condition, n_workers=2, timeout=60.0, tpu_variants=None):
+    """Run queue+workers until condition(server) or timeout; returns queue."""
+
+    async def main():
+        api = ApiClient(Endpoint(server.url), "testkey")
+        queue = Queue(
+            api,
+            cores=n_workers,
+            backlog=BacklogOpt(),
+            stats=StatsRecorder(no_stats_file=True, cores=n_workers),
+            logger=Logger(verbose=0),
+            tpu_variants=tpu_variants,
+        )
+        factory = lambda flavor: PyEngine(max_depth=2)
+        tasks = [
+            asyncio.create_task(worker(i, queue, factory)) for i in range(n_workers)
+        ]
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not condition(server):
+            if asyncio.get_running_loop().time() > deadline:
+                break
+            await asyncio.sleep(0.05)
+        queue.stop_acquiring()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        await queue.drain_submissions()
+        return queue
+
+    return asyncio.run(main())
+
+
+@pytest.fixture()
+def server():
+    s = FakeLichess().start()
+    yield s
+    s.stop()
+
+
+def test_analysis_end_to_end(server):
+    moves = ["e2e4", "c7c5", "g1f3", "d7d6"]
+    server.add_analysis_job("job00001", START, moves, timeout_ms=4000)
+    run_client_until(server, lambda s: "job00001" in s.analyses)
+    submissions = server.analyses["job00001"]
+    assert submissions, "no analysis submitted"
+    final = submissions[-1]
+    assert final["fishnet"]["apikey"] == "testkey"
+    assert final["stockfish"]["flavor"] == "nnue"
+    analysis = final["analysis"]
+    assert len(analysis) == 5  # 4 moves → 5 positions
+    for part in analysis:
+        assert part is not None
+        assert "score" in part and "depth" in part and "nodes" in part
+        assert "cp" in part["score"] or "mate" in part["score"]
+
+
+def test_analysis_with_skips(server):
+    moves = ["e2e4", "e7e5", "g1f3"]
+    server.add_analysis_job("job00002", START, moves, skip=[1], timeout_ms=4000)
+    run_client_until(server, lambda s: "job00002" in s.analyses)
+    final = server.analyses["job00002"][-1]
+    analysis = final["analysis"]
+    assert len(analysis) == 4
+    assert analysis[1] == {"skipped": True}
+    assert analysis[0] is not None and "score" in analysis[0]
+
+
+def test_move_job_end_to_end(server):
+    server.add_move_job("mv000001", START, ["e2e4", "e7e5"], level=8)
+    run_client_until(server, lambda s: "mv000001" in s.moves)
+    body = server.moves["mv000001"]
+    assert body["move"]["bestmove"], "no bestmove submitted"
+    # bestmove must be a legal reply in the position after e4 e5
+    from fishnet_tpu.chess import Position
+
+    pos = Position.initial().push_uci("e2e4").push_uci("e7e5")
+    legal = {m.uci() for m in pos.legal_moves()}
+    assert body["move"]["bestmove"] in legal
+
+
+def test_mate_position_reports_mate_zero(server):
+    # fool's mate: final position is checkmate; its analysis part must be
+    # depth 0 / mate 0 (reference: doc/protocol.md:99-104)
+    moves = ["f2f3", "e7e5", "g2g4", "d8h4"]
+    server.add_analysis_job("job00003", START, moves, timeout_ms=4000)
+    run_client_until(server, lambda s: "job00003" in s.analyses)
+    final = server.analyses["job00003"][-1]
+    last_part = final["analysis"][-1]
+    assert last_part["score"] == {"mate": 0}
+    assert last_part["depth"] == 0
+
+
+def test_checkmate_in_one_found(server):
+    # position before the mating move: engine should find mate
+    moves = ["f2f3", "e7e5", "g2g4"]
+    server.add_analysis_job("job00004", START, moves, timeout_ms=4000)
+    run_client_until(server, lambda s: "job00004" in s.analyses)
+    final = server.analyses["job00004"][-1]
+    last_part = final["analysis"][-1]  # black to move, mate in 1
+    assert last_part["score"] == {"mate": 1}
+
+
+def test_variant_analysis_reports_hce(server):
+    server.add_analysis_job(
+        "job00005", START, ["e2e4"], variant="kingOfTheHill", timeout_ms=4000
+    )
+    run_client_until(server, lambda s: "job00005" in s.analyses)
+    final = server.analyses["job00005"][-1]
+    assert final["stockfish"]["flavor"] == "classical"
+
+
+def test_abort_on_shutdown(server):
+    # a job with many positions: shut down before completion → abort POSTed
+    moves = ["e2e4", "c7c5", "g1f3", "d7d6", "d2d4", "c5d4", "f3d4", "g8f6",
+             "b1c3", "a7a6", "f1e2", "e7e5", "d4b3", "f8e7", "e1h1", "e8h8"]
+
+    async def main():
+        api = ApiClient(Endpoint(server.url), "testkey")
+        queue = Queue(api, cores=1, logger=Logger())
+        server.add_analysis_job("job00006", START, moves, timeout_ms=60000)
+        factory = lambda flavor: PyEngine(max_depth=1)
+        task = asyncio.create_task(worker(0, queue, factory))
+        # wait for the batch to be acquired
+        deadline = asyncio.get_running_loop().time() + 30
+        while not queue.pending and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        assert queue.pending
+        await queue.shutdown()
+        await asyncio.wait_for(task, timeout=30)
+
+    asyncio.run(main())
+    assert "job00006" in server.aborted
