@@ -1,0 +1,1 @@
+"""Device-side chess ops: board representation, movegen, NNUE eval, search."""

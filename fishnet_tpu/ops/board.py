@@ -1,0 +1,210 @@
+"""Device board representation and move making.
+
+The engine-process boundary of the reference (UCI pipes into Stockfish,
+reference: src/stockfish.rs:124-143) becomes a host→device dispatch here:
+positions live as SoA tensors and moves are applied by scatter, `vmap`-able
+over the batch/lane dimension.
+
+Board tensor layout (one lane):
+  board:    (64,) int32, piece codes (tables.py: 0 empty, 1-6 white, 7-12 black)
+  stm:      ()   int32, 0 white / 1 black
+  ep:       ()   int32, en-passant target square or -1
+  castling: (4,) int32, rook squares with castling rights, -1 if gone;
+            order [white-kingside, white-queenside, black-kingside,
+            black-queenside] (chess960-ready: stores actual rook squares)
+  halfmove: ()   int32
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chess.position import Position
+from ..chess.types import scan
+from . import tables as T
+
+
+class Board(NamedTuple):
+    board: jnp.ndarray  # (..., 64) int32
+    stm: jnp.ndarray  # (...,) int32
+    ep: jnp.ndarray  # (...,) int32
+    castling: jnp.ndarray  # (..., 4) int32
+    halfmove: jnp.ndarray  # (...,) int32
+
+
+def from_position(pos: Position) -> Board:
+    """Host Position → single-lane Board (numpy)."""
+    board = np.zeros(64, dtype=np.int32)
+    for color in (0, 1):
+        for ptype in range(6):
+            for sq in scan(pos.bbs[color][ptype]):
+                board[sq] = 1 + ptype + 6 * color
+    castling = np.full(4, -1, dtype=np.int32)
+    for color in (0, 1):
+        ksq = pos.king_sq(color)
+        back = 0xFF if color == 0 else 0xFF << 56
+        rights = pos.castling & back
+        for rsq in scan(rights):
+            if ksq is None:
+                continue
+            side = 0 if rsq > ksq else 1
+            castling[color * 2 + side] = rsq
+    return Board(
+        board=jnp.asarray(board),
+        stm=jnp.asarray(np.int32(pos.turn)),
+        ep=jnp.asarray(np.int32(pos.ep_square if pos.ep_square is not None else -1)),
+        castling=jnp.asarray(castling),
+        halfmove=jnp.asarray(np.int32(pos.halfmove)),
+    )
+
+
+def stack_boards(boards) -> Board:
+    """List of single-lane Boards → batched Board."""
+    return Board(*[jnp.stack([getattr(b, f) for b in boards]) for f in Board._fields])
+
+
+def piece_color(code: jnp.ndarray) -> jnp.ndarray:
+    """0 white, 1 black, -1 empty."""
+    return jnp.where(code == 0, -1, jnp.where(code <= 6, 0, 1))
+
+
+def piece_type(code: jnp.ndarray) -> jnp.ndarray:
+    """0..5 = P N B R Q K, -1 empty."""
+    return jnp.where(code == 0, -1, (code - 1) % 6)
+
+
+def is_attacked(board64: jnp.ndarray, sq: jnp.ndarray, by_color: jnp.ndarray) -> jnp.ndarray:
+    """Is `sq` attacked by `by_color` on `board64`? Single-square query used
+    for check detection and castling-path tests; O(8 dirs × 7 steps) gathers.
+    All args unbatched (vmap for lanes)."""
+    rays = jnp.asarray(T.RAYS)[sq]  # (8, 7)
+    valid = rays >= 0
+    ray_pieces = jnp.where(valid, board64[jnp.clip(rays, 0)], 0)  # (8, 7)
+    occupied = ray_pieces > 0
+    # first occupied step along each ray
+    before = jnp.cumsum(occupied, axis=1) - occupied.astype(jnp.int32)
+    is_first = occupied & (before == 0)
+    slider_ok = jnp.asarray(T.SLIDER_MASK)[
+        jnp.arange(8)[:, None], ray_pieces
+    ]  # (8, 7) does this piece slide along this dir
+    enemy = piece_color(ray_pieces) == by_color
+    slider_hit = jnp.any(is_first & slider_ok & enemy & valid)
+
+    # king adjacency: first step of each ray
+    first_sq_piece = ray_pieces[:, 0]
+    king_code = jnp.where(by_color == 0, T.W_KING, T.B_KING)
+    king_hit = jnp.any(valid[:, 0] & (first_sq_piece == king_code))
+
+    knight_tgts = jnp.asarray(T.KNIGHT_TARGETS)[sq]  # (8,)
+    kvalid = knight_tgts >= 0
+    knight_code = jnp.where(by_color == 0, T.W_KNIGHT, T.B_KNIGHT)
+    knight_hit = jnp.any(kvalid & (board64[jnp.clip(knight_tgts, 0)] == knight_code))
+
+    # pawns of by_color attacking sq sit on the squares a pawn of the
+    # *opposite* color on sq would attack
+    pawn_srcs = jnp.asarray(T.PAWN_CAPTURES)[1 - by_color, sq]  # (2,)
+    pvalid = pawn_srcs >= 0
+    pawn_code = jnp.where(by_color == 0, T.W_PAWN, T.B_PAWN)
+    pawn_hit = jnp.any(pvalid & (board64[jnp.clip(pawn_srcs, 0)] == pawn_code))
+
+    return slider_hit | king_hit | knight_hit | pawn_hit
+
+
+def king_square(board64: jnp.ndarray, color: jnp.ndarray) -> jnp.ndarray:
+    """Square of `color`'s king, or -1 if absent (unbatched)."""
+    king_code = jnp.where(color == 0, T.W_KING, T.B_KING)
+    mask = board64 == king_code
+    return jnp.where(jnp.any(mask), jnp.argmax(mask), -1)
+
+
+def in_check(b: Board) -> jnp.ndarray:
+    ksq = king_square(b.board, b.stm)
+    return jnp.where(
+        ksq >= 0, is_attacked(b.board, jnp.maximum(ksq, 0), 1 - b.stm), False
+    )
+
+
+def make_move(b: Board, move: jnp.ndarray) -> Board:
+    """Apply an encoded move (from | to<<6 | promo<<12) to one lane.
+
+    Castling is encoded king-takes-own-rook (matching the host library and
+    UCI_Chess960 semantics); en passant and promotion are inferred from the
+    board, so no flag bits are needed.
+    """
+    frm = move & 63
+    to = (move >> 6) & 63
+    promo = (move >> 12) & 7
+
+    board = b.board
+    piece = board[frm]
+    target = board[to]
+    us = b.stm
+    them = 1 - us
+
+    is_pawn = piece_type(piece) == 0
+    is_king = piece_type(piece) == 5
+    is_castle = is_king & (piece_color(target) == us) & (piece_type(target) == 3)
+
+    # en passant capture: pawn moves diagonally onto the empty ep square
+    is_ep = is_pawn & (to == b.ep) & (target == 0) & ((to & 7) != (frm & 7))
+    ep_victim = jnp.where(us == 0, to - 8, to + 8)
+
+    new_board = board.at[frm].set(0)
+    new_board = jnp.where(
+        is_ep, new_board.at[jnp.clip(ep_victim, 0, 63)].set(0), new_board
+    )
+
+    # normal placement (promotion replaces the pawn)
+    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
+    placed = jnp.where(promo > 0, promo_piece, piece)
+    normal_board = new_board.at[to].set(placed)
+
+    # castling: clear rook square too, then place king on g/c and rook on f/d
+    rank_base = jnp.where(us == 0, 0, 56)
+    kingside = to > frm
+    k_dest = rank_base + jnp.where(kingside, 6, 2)
+    r_dest = rank_base + jnp.where(kingside, 5, 3)
+    castle_board = new_board.at[to].set(0)
+    castle_board = castle_board.at[k_dest].set(piece)
+    castle_board = castle_board.at[r_dest].set(jnp.where(us == 0, T.W_ROOK, T.B_ROOK))
+
+    out_board = jnp.where(is_castle, castle_board, normal_board)
+
+    # castling rights: clear own on king move; clear a rook square on touch
+    cast = b.castling
+    own_slots = jnp.arange(4) // 2 == us
+    cast = jnp.where(is_king & own_slots, -1, cast)
+    cast = jnp.where((cast == frm) | (cast == to), -1, cast)
+
+    # new ep square on double pawn push
+    dbl = is_pawn & (jnp.abs(to - frm) == 16)
+    new_ep = jnp.where(dbl, (frm + to) // 2, -1)
+
+    capture = (piece_color(target) == them) | is_ep
+    new_halfmove = jnp.where(is_pawn | capture, 0, b.halfmove + 1)
+
+    return Board(
+        board=out_board,
+        stm=them,
+        ep=new_ep,
+        castling=cast,
+        halfmove=new_halfmove,
+    )
+
+
+# batched versions
+v_make_move = jax.vmap(make_move, in_axes=(Board(0, 0, 0, 0, 0), 0))
+v_in_check = jax.vmap(in_check, in_axes=(Board(0, 0, 0, 0, 0),))
+
+
+def to_position_debug(b: Board) -> str:
+    """ASCII board for debugging (single lane, host)."""
+    chars = ".PNBRQKpnbrqk"
+    arr = np.asarray(b.board)
+    rows = []
+    for rank in range(7, -1, -1):
+        rows.append(" ".join(chars[arr[rank * 8 + f]] for f in range(8)))
+    return "\n".join(rows)

@@ -1,0 +1,239 @@
+"""Batched pseudo-legal move generation.
+
+Strategy (TPU-first, no data-dependent shapes): enumerate a fixed candidate
+space — (64 sq × 8 dirs × 7 steps) slider slots, (64×8) knight and king
+slots, (64×4) pawn slots, (64×3×4) promotion slots, 2 castling slots — as
+masks, then compact valid candidates into a fixed (MAX_MOVES,) move list
+with a cumsum scatter. Legality is *not* fully resolved here: the search
+uses king-capture pruning (an illegal mover is refuted one ply later when
+its king is captured), so only castling does attack checks. This keeps the
+kernel free of pin/evasion logic; the host library remains the legality
+oracle for tests.
+
+Single-lane function; `vmap` over lanes gives the batch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import tables as T
+from .board import Board, is_attacked, king_square, piece_color, piece_type
+
+MAX_MOVES = T.MAX_MOVES
+
+
+def _compact(cands: jnp.ndarray, valid: jnp.ndarray, keys: jnp.ndarray):
+    """Scatter valid candidate moves into a dense (MAX_MOVES,) list.
+
+    keys: smaller = earlier after the final sort (move ordering).
+    Returns (moves, keys, count); overflow beyond MAX_MOVES is dropped.
+    """
+    cands = cands.reshape(-1)
+    valid = valid.reshape(-1)
+    keys = keys.reshape(-1)
+    pos = jnp.cumsum(valid) - valid.astype(jnp.int32)
+    idx = jnp.where(valid, pos, MAX_MOVES)  # out-of-range → dropped
+    moves = jnp.full((MAX_MOVES,), -1, dtype=jnp.int32)
+    out_keys = jnp.full((MAX_MOVES,), jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    moves = moves.at[idx].set(cands, mode="drop")
+    out_keys = out_keys.at[idx].set(keys, mode="drop")
+    count = jnp.minimum(jnp.sum(valid), MAX_MOVES)
+    return moves, out_keys, count
+
+
+def _capture_key(victim_type: jnp.ndarray, attacker_type: jnp.ndarray,
+                 is_capture: jnp.ndarray, promo: jnp.ndarray) -> jnp.ndarray:
+    """MVV-LVA ordering key (smaller = searched first): queen promos, then
+    captures by victim desc / attacker asc, then quiets."""
+    mvv_lva = (5 - victim_type) * 8 + attacker_type
+    key = jnp.where(is_capture, 100 + mvv_lva, 1000)
+    key = jnp.where(promo == T.PROMO_Q, key - 90, key)
+    return key.astype(jnp.int32)
+
+
+def generate_moves(b: Board):
+    """→ (moves (MAX_MOVES,) int32 sorted by ordering key, count ()).
+
+    Moves are encoded from | to<<6 | promo<<12; castling is king-takes-rook.
+    """
+    board = b.board
+    us = b.stm
+    them = 1 - us
+    colors = piece_color(board)  # (64,)
+    types = piece_type(board)  # (64,)
+    own = colors == us
+    occ = board > 0
+    sq_idx = jnp.arange(64, dtype=jnp.int32)
+
+    all_moves = []
+    all_valid = []
+    all_keys = []
+
+    # ---------------------------------------------------------------- sliders
+    rays = jnp.asarray(T.RAYS)  # (64, 8, 7)
+    rvalid = rays >= 0
+    rsq = jnp.clip(rays, 0)
+    rpiece = board[rsq]  # (64, 8, 7)
+    rocc = (rpiece > 0) & rvalid
+    before = jnp.cumsum(rocc, axis=2) - rocc.astype(jnp.int32)
+    reachable = rvalid & (before == 0)
+    target_own = piece_color(rpiece) == us
+    target_enemy = piece_color(rpiece) == them
+    slides = jnp.asarray(T.SLIDER_MASK).T[board]  # (64, 8): our piece slides dir?
+    valid = (
+        own[:, None, None]
+        & slides[:, :, None]
+        & reachable
+        & ~(target_own & rocc)
+    )
+    cands = sq_idx[:, None, None] | (rsq << 6)
+    keys = _capture_key(
+        jnp.maximum(piece_type(rpiece), 0), types[:, None, None],
+        target_enemy & rocc, jnp.zeros_like(rpiece),
+    )
+    all_moves.append(cands)
+    all_valid.append(valid)
+    all_keys.append(keys)
+
+    # ---------------------------------------------------------- knights, king
+    for table, ptype_want in ((T.KNIGHT_TARGETS, 1), (T.KING_TARGETS, 5)):
+        tg = jnp.asarray(table)  # (64, 8)
+        tvalid = tg >= 0
+        tsq = jnp.clip(tg, 0)
+        tpiece = board[tsq]
+        valid = (
+            own[:, None]
+            & (types == ptype_want)[:, None]
+            & tvalid
+            & ~(piece_color(tpiece) == us)
+        )
+        cands = sq_idx[:, None] | (tsq << 6)
+        keys = _capture_key(
+            jnp.maximum(piece_type(tpiece), 0),
+            jnp.full_like(tpiece, ptype_want),
+            piece_color(tpiece) == them,
+            jnp.zeros_like(tpiece),
+        )
+        all_moves.append(cands)
+        all_valid.append(valid)
+        all_keys.append(keys)
+
+    # ------------------------------------------------------------------ pawns
+    fwd = jnp.where(us == 0, 8, -8)
+    our_pawn = own & (types == 0)
+    ranks = sq_idx >> 3
+    last_rank = jnp.where(us == 0, 7, 0)
+    start_rank = jnp.where(us == 0, 1, 6)
+    pre_promo = ranks == jnp.where(us == 0, 6, 1)
+
+    to1 = jnp.clip(sq_idx + fwd, 0, 63)
+    to1_ok = our_pawn & (board[to1] == 0)
+    to2 = jnp.clip(sq_idx + 2 * fwd, 0, 63)
+    to2_ok = to1_ok & (ranks == start_rank) & (board[to2] == 0)
+
+    caps = jnp.asarray(T.PAWN_CAPTURES)[us]  # (64, 2)
+    cvalid = caps >= 0
+    csq = jnp.clip(caps, 0)
+    cpiece = board[csq]
+    cap_ok = (
+        our_pawn[:, None]
+        & cvalid
+        & ((piece_color(cpiece) == them) | (csq == b.ep))
+    )
+
+    # non-promotion pawn moves: [push1, push2, capL, capR]
+    pawn_tos = jnp.stack([to1, to2, csq[:, 0], csq[:, 1]], axis=1)  # (64,4)
+    pawn_ok = jnp.stack(
+        [to1_ok & ~pre_promo, to2_ok, cap_ok[:, 0] & ~pre_promo[:],
+         cap_ok[:, 1] & ~pre_promo[:]], axis=1,
+    )
+    cands = sq_idx[:, None] | (pawn_tos << 6)
+    vict = jnp.maximum(piece_type(board[pawn_tos]), 0)
+    is_cap = jnp.stack(
+        [jnp.zeros(64, bool), jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]],
+        axis=1,
+    )
+    keys = _capture_key(vict, jnp.zeros_like(vict), is_cap, jnp.zeros_like(vict))
+    all_moves.append(cands)
+    all_valid.append(pawn_ok)
+    all_keys.append(keys)
+
+    # promotions: [push, capL, capR] × 4 promo pieces
+    promo_tos = jnp.stack([to1, csq[:, 0], csq[:, 1]], axis=1)  # (64, 3)
+    promo_ok_base = jnp.stack(
+        [to1_ok & pre_promo, cap_ok[:, 0] & pre_promo, cap_ok[:, 1] & pre_promo],
+        axis=1,
+    )
+    promos = jnp.asarray(
+        [T.PROMO_N, T.PROMO_B, T.PROMO_R, T.PROMO_Q], dtype=jnp.int32
+    )
+    cands = (
+        sq_idx[:, None, None]
+        | (promo_tos[:, :, None] << 6)
+        | (promos[None, None, :] << 12)
+    )
+    valid = promo_ok_base[:, :, None] & jnp.ones((1, 1, 4), bool)
+    vict = jnp.maximum(piece_type(board[promo_tos]), 0)[:, :, None]
+    is_cap = jnp.stack([jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]], axis=1)
+    keys = _capture_key(
+        jnp.broadcast_to(vict, cands.shape),
+        jnp.zeros_like(cands),
+        jnp.broadcast_to(is_cap[:, :, None], cands.shape),
+        jnp.broadcast_to(promos[None, None, :], cands.shape),
+    )
+    all_moves.append(cands)
+    all_valid.append(valid)
+    all_keys.append(keys)
+
+    # --------------------------------------------------------------- castling
+    ksq = king_square(board, us)
+    ksq_c = jnp.maximum(ksq, 0)
+    rook_slots = jnp.take(b.castling, jnp.arange(2) + us * 2)  # [kingside, queenside]
+
+    def castle_ok(slot):
+        rsq = rook_slots[slot]
+        has = (rsq >= 0) & (ksq >= 0)
+        rsq_c = jnp.clip(rsq, 0, 63)
+        rank_base = jnp.where(us == 0, 0, 56)
+        kingside = slot == 0
+        k_dest = rank_base + jnp.where(kingside, 6, 2)
+        r_dest = rank_base + jnp.where(kingside, 5, 3)
+        # all squares the king or rook crosses (inclusive spans), minus the
+        # two moving pieces, must be empty
+        lo_k = jnp.minimum(ksq_c, k_dest)
+        hi_k = jnp.maximum(ksq_c, k_dest)
+        lo_r = jnp.minimum(rsq_c, r_dest)
+        hi_r = jnp.maximum(rsq_c, r_dest)
+        span = ((sq_idx >= lo_k) & (sq_idx <= hi_k)) | (
+            (sq_idx >= lo_r) & (sq_idx <= hi_r)
+        )
+        span = span & (sq_idx != ksq_c) & (sq_idx != rsq_c)
+        empty_ok = ~jnp.any(span & occ)
+        # king path (origin..dest inclusive) must not be attacked; test with
+        # king and castling rook lifted off the board
+        clean = board.at[ksq_c].set(0).at[rsq_c].set(0)
+        kpath = (sq_idx >= lo_k) & (sq_idx <= hi_k)
+        attacked = jax.vmap(
+            lambda s, m: jnp.where(m, is_attacked(clean, s, them), False)
+        )(sq_idx, kpath)
+        safe = ~jnp.any(attacked)
+        return has & empty_ok & safe, sq_idx[0] * 0 + (ksq_c | (rsq_c << 6))
+
+    ok0, mv0 = castle_ok(jnp.int32(0))
+    ok1, mv1 = castle_ok(jnp.int32(1))
+    all_moves.append(jnp.stack([mv0, mv1]))
+    all_valid.append(jnp.stack([ok0, ok1]))
+    all_keys.append(jnp.full((2,), 900, dtype=jnp.int32))
+
+    flat_moves = jnp.concatenate([m.reshape(-1) for m in all_moves])
+    flat_valid = jnp.concatenate([v.reshape(-1) for v in all_valid])
+    flat_keys = jnp.concatenate([k.reshape(-1) for k in all_keys])
+    moves, keys, count = _compact(flat_moves, flat_valid, flat_keys)
+
+    # order: stable sort by key so captures/promotions are searched first
+    order = jnp.argsort(keys, stable=True)
+    return moves[order], count
+
+
+v_generate_moves = jax.vmap(generate_moves, in_axes=(Board(0, 0, 0, 0, 0),))

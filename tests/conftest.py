@@ -1,17 +1,32 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths are
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 exercised without TPU hardware (the driver separately dry-run-compiles the
 multichip path via __graft_entry__.dryrun_multichip).
+
+This environment registers a remote-TPU ("axon") PJRT plugin from
+sitecustomize at interpreter start; once registered, even JAX_PLATFORMS=cpu
+still initializes it on first use (and hangs when the tunnel is down).
+Backend *initialization* is lazy though, so deregistering the factory here —
+before any jax operation — cleanly forces CPU.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,117 @@
+"""Property tests: device movegen/make_move vs the perft-validated host
+rules library over random playouts.
+
+The device generator is pseudo-legal with legality-checked castling — which
+is exactly what the host's generate_pseudo_legal + _castling_moves produce,
+so the move *sets* must match square-for-square.
+"""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from fishnet_tpu.chess import Move, Position
+from fishnet_tpu.chess.position import Chess960Position
+from fishnet_tpu.ops import tables as T
+from fishnet_tpu.ops.board import Board, from_position, in_check, make_move
+from fishnet_tpu.ops.movegen import generate_moves
+
+_PROMO_MAP = {1: T.PROMO_N, 2: T.PROMO_B, 3: T.PROMO_R, 4: T.PROMO_Q}
+
+
+def encode_host_move(m: Move) -> int:
+    promo = _PROMO_MAP[m.promotion] if m.promotion is not None else 0
+    return m.from_sq | (m.to_sq << 6) | (promo << 12)
+
+
+def host_pseudo_set(pos: Position):
+    return {encode_host_move(m) for m in pos.generate_pseudo_legal()}
+
+
+@pytest.fixture(scope="module")
+def kernels():
+    return jax.jit(generate_moves), jax.jit(make_move), jax.jit(in_check)
+
+
+def device_move_set(gen, pos: Position):
+    moves, count = gen(from_position(pos))
+    return set(np.asarray(moves)[: int(count)].tolist())
+
+
+def boards_equal(b1: Board, b2: Board) -> bool:
+    return (
+        np.array_equal(np.asarray(b1.board), np.asarray(b2.board))
+        and int(b1.stm) == int(b2.stm)
+        and int(b1.ep) == int(b2.ep)
+        and sorted(np.asarray(b1.castling).tolist())
+        == sorted(np.asarray(b2.castling).tolist())
+        and int(b1.halfmove) == int(b2.halfmove)
+    )
+
+
+def _playout_check(kernels, pos: Position, plies: int, rng: random.Random):
+    gen, mk, chk = kernels
+    for ply in range(plies):
+        legal = pos.legal_moves()
+        if not legal:
+            break
+        host_set = host_pseudo_set(pos)
+        dev_set = device_move_set(gen, pos)
+        assert dev_set == host_set, (
+            f"move set mismatch at ply {ply}\nfen={pos.to_fen()}\n"
+            f"host-only={sorted(host_set - dev_set)}\n"
+            f"device-only={sorted(dev_set - host_set)}"
+        )
+        assert bool(chk(from_position(pos))) == pos.is_check()
+        move = rng.choice(legal)
+        child = pos.push(move)
+        dev_child = mk(from_position(pos), encode_host_move(move))
+        assert boards_equal(dev_child, from_position(child)), (
+            f"make_move mismatch at ply {ply}: {move.uci()}\n"
+            f"fen={pos.to_fen()} → {child.to_fen()}"
+        )
+        pos = child
+
+
+def test_random_playouts_standard(kernels):
+    rng = random.Random(42)
+    for game in range(6):
+        _playout_check(kernels, Position.initial(), 60, rng)
+
+
+def test_playouts_tactical_fens(kernels):
+    rng = random.Random(7)
+    fens = [
+        # kiwipete: castling + pins + promos nearby
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+        # CPW pos 4: promotions and underpromotions
+        "r3k2r/Pppp1ppp/1b3nbN/nP6/BBP1P3/q4N2/Pp1P2PP/R2Q1RK1 w kq - 0 1",
+        # en-passant rich
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    ]
+    for fen in fens:
+        for _ in range(3):
+            _playout_check(kernels, Position.from_fen(fen), 40, rng)
+
+
+def test_playouts_chess960(kernels):
+    rng = random.Random(3)
+    fens = [
+        "bqnb1rkr/pp3ppp/3ppn2/2p5/5P2/P2P4/NPP1P1PP/BQ1BNRKR w HFhf - 2 9",
+        "b1q1rrkb/pppppppp/3nn3/8/P7/1PPP4/4PPPP/BQNNRKRB w GE - 1 9",
+    ]
+    for fen in fens:
+        for _ in range(3):
+            _playout_check(kernels, Chess960Position.from_fen(fen), 40, rng)
+
+
+def test_castling_move_application(kernels):
+    _, mk, _ = kernels
+    pos = Position.from_fen("r3k2r/8/8/8/8/8/8/R3K2R w KQkq - 0 1")
+    child = pos.push_uci("e1h1")
+    dev = mk(from_position(pos), encode_host_move(pos.parse_uci("e1h1")))
+    assert boards_equal(dev, from_position(child))
+    child_q = pos.push_uci("e1a1")
+    dev_q = mk(from_position(pos), encode_host_move(pos.parse_uci("e1a1")))
+    assert boards_equal(dev_q, from_position(child_q))
