@@ -1,0 +1,1 @@
+"""Model zoo: NNUE evaluation networks (the framework's flagship model)."""

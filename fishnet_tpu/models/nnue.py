@@ -1,0 +1,218 @@
+"""NNUE evaluation network (HalfKAv2_hm feature set) in JAX.
+
+The reference ships Stockfish's nets as opaque binaries inside the engine
+(reference: build.rs:8-9 embeds nn-1c0000000000.nnue + nn-37f18f62d772.nnue;
+the engines evaluate them in C++). Here the network is a first-class model:
+HalfKAv2_hm features (32 horizontally-mirrored king buckets × 11 piece
+kinds × 64 squares = 22528 inputs per perspective), a perspective-shared
+feature transform, and a bucketed layer stack selected by piece count —
+resident in HBM as arrays, evaluated by XLA, and trainable in-framework
+(fishnet_tpu.models.train).
+
+Weights are float (bf16/f32) rather than Stockfish's int8/int16: the MXU
+natively prefers bf16, and quantization is a later optimization, not a
+architectural requirement as it is on CPU.
+"""
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import tables as T
+from ..ops.board import king_square, piece_color, piece_type
+
+NUM_KING_BUCKETS = 32
+NUM_PIECE_KINDS = 11  # our P N B R Q, their P N B R Q, kings (shared plane)
+NUM_SQUARES = 64
+NUM_FEATURES = NUM_KING_BUCKETS * NUM_PIECE_KINDS * NUM_SQUARES  # 22528
+NUM_OUTPUT_BUCKETS = 8
+OUTPUT_SCALE = 600.0  # network output [-1,1]-ish → centipawns
+
+# king bucket: files a-d (after mirroring) × 8 ranks
+_KING_BUCKET = np.full(64, -1, dtype=np.int32)
+for _sq in range(64):
+    _f, _r = _sq & 7, _sq >> 3
+    if _f < 4:
+        _KING_BUCKET[_sq] = _r * 4 + _f
+KING_BUCKET = _KING_BUCKET
+
+
+class NnueParams(NamedTuple):
+    ft_w: jnp.ndarray  # (NUM_FEATURES, L1)
+    ft_b: jnp.ndarray  # (L1,)
+    l1_w: jnp.ndarray  # (NUM_OUTPUT_BUCKETS, 2*L1, H1)
+    l1_b: jnp.ndarray  # (NUM_OUTPUT_BUCKETS, H1)
+    l2_w: jnp.ndarray  # (NUM_OUTPUT_BUCKETS, H1, H2)
+    l2_b: jnp.ndarray  # (NUM_OUTPUT_BUCKETS, H2)
+    out_w: jnp.ndarray  # (NUM_OUTPUT_BUCKETS, H2)
+    out_b: jnp.ndarray  # (NUM_OUTPUT_BUCKETS,)
+
+    @property
+    def l1(self) -> int:
+        return self.ft_w.shape[1]
+
+
+def init_params(
+    key, l1: int = 256, h1: int = 16, h2: int = 32, dtype=jnp.float32
+) -> NnueParams:
+    k = jax.random.split(key, 4)
+    return NnueParams(
+        ft_w=(jax.random.normal(k[0], (NUM_FEATURES, l1)) * 0.02).astype(dtype),
+        ft_b=jnp.full((l1,), 0.5, dtype),
+        l1_w=(jax.random.normal(k[1], (NUM_OUTPUT_BUCKETS, 2 * l1, h1))
+              * (1.0 / np.sqrt(2 * l1))).astype(dtype),
+        l1_b=jnp.zeros((NUM_OUTPUT_BUCKETS, h1), dtype),
+        l2_w=(jax.random.normal(k[2], (NUM_OUTPUT_BUCKETS, h1, h2))
+              * (1.0 / np.sqrt(h1))).astype(dtype),
+        l2_b=jnp.zeros((NUM_OUTPUT_BUCKETS, h2), dtype),
+        out_w=(jax.random.normal(k[3], (NUM_OUTPUT_BUCKETS, h2))
+               * (1.0 / np.sqrt(h2))).astype(dtype),
+        out_b=jnp.zeros((NUM_OUTPUT_BUCKETS,), dtype),
+    )
+
+
+# ------------------------------------------------------------------ features
+
+
+def feature_indices(board64: jnp.ndarray, perspective: jnp.ndarray,
+                    ksq: jnp.ndarray) -> jnp.ndarray:
+    """(64,) feature index per square for one perspective; -1 where empty.
+
+    Orientation: flip ranks for black's perspective, then mirror files so
+    the king lands on files a-d (the _hm halving).
+    """
+    sq = jnp.arange(64, dtype=jnp.int32)
+    flip = jnp.where(perspective == 1, 56, 0)
+    o_sq = sq ^ flip
+    o_ksq = ksq ^ flip
+    mirror = jnp.where((o_ksq & 7) > 3, 7, 0)
+    o_sq = o_sq ^ mirror
+    o_ksq = o_ksq ^ mirror
+    bucket = jnp.asarray(KING_BUCKET)[o_ksq]
+
+    code = board64
+    pt = piece_type(code)  # -1 empty, 0..5
+    col = piece_color(code)
+    kind = jnp.where(pt == 5, 10, jnp.where(col == perspective, pt, 5 + pt))
+    idx = bucket * (NUM_PIECE_KINDS * NUM_SQUARES) + kind * NUM_SQUARES + o_sq
+    return jnp.where(code > 0, idx, -1)
+
+
+def refresh_accumulator(params: NnueParams, board64: jnp.ndarray,
+                        perspective: jnp.ndarray) -> jnp.ndarray:
+    """(L1,) accumulator for one perspective, recomputed from scratch."""
+    ksq = king_square(board64, perspective)
+    idx = feature_indices(board64, perspective, jnp.maximum(ksq, 0))
+    rows = params.ft_w[jnp.clip(idx, 0)]  # (64, L1)
+    rows = jnp.where((idx >= 0)[:, None], rows, 0)
+    return params.ft_b + jnp.sum(rows, axis=0)
+
+
+def accumulators(params: NnueParams, board64: jnp.ndarray) -> jnp.ndarray:
+    """(2, L1): white and black perspective accumulators."""
+    return jnp.stack(
+        [
+            refresh_accumulator(params, board64, jnp.int32(0)),
+            refresh_accumulator(params, board64, jnp.int32(1)),
+        ]
+    )
+
+
+# ------------------------------------------------------------------- forward
+
+
+def _crelu(x):
+    return jnp.clip(x, 0.0, 1.0)
+
+
+def output_bucket(board64: jnp.ndarray) -> jnp.ndarray:
+    count = jnp.sum(board64 > 0)
+    return jnp.clip((count - 1) // 4, 0, NUM_OUTPUT_BUCKETS - 1)
+
+
+def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
+                     bucket: jnp.ndarray) -> jnp.ndarray:
+    """Centipawn score from the side to move's perspective (scalar f32)."""
+    own = jnp.where(stm == 0, acc[0], acc[1])
+    opp = jnp.where(stm == 0, acc[1], acc[0])
+    x = jnp.concatenate([_crelu(own), _crelu(opp)])  # (2*L1,)
+    w1 = params.l1_w[bucket]
+    h = _crelu(x @ w1 + params.l1_b[bucket])
+    h = _crelu(h @ params.l2_w[bucket] + params.l2_b[bucket])
+    out = h @ params.out_w[bucket] + params.out_b[bucket]
+    return out * OUTPUT_SCALE
+
+
+def evaluate(params: NnueParams, board64: jnp.ndarray, stm: jnp.ndarray) -> jnp.ndarray:
+    """Full evaluation of one lane (refresh + forward)."""
+    acc = accumulators(params, board64)
+    return forward_from_acc(params, acc, stm, output_bucket(board64))
+
+
+v_evaluate = jax.vmap(evaluate, in_axes=(None, 0, 0))
+
+
+# ------------------------------------------------- host reference (numpy)
+
+
+def evaluate_reference(params: NnueParams, board64: np.ndarray, stm: int) -> float:
+    """Pure-numpy reference implementation for parity tests."""
+    p = jax.tree_util.tree_map(np.asarray, params)
+    accs = []
+    for persp in (0, 1):
+        king_code = 6 if persp == 0 else 12
+        ksq = int(np.argmax(board64 == king_code))
+        flip = 56 if persp == 1 else 0
+        o_ksq = ksq ^ flip
+        mirror = 7 if (o_ksq & 7) > 3 else 0
+        o_ksq ^= mirror
+        bucket = KING_BUCKET[o_ksq]
+        acc = p.ft_b.astype(np.float64).copy()
+        for sq in range(64):
+            code = int(board64[sq])
+            if code == 0:
+                continue
+            pt = (code - 1) % 6
+            col = 0 if code <= 6 else 1
+            kind = 10 if pt == 5 else (pt if col == persp else 5 + pt)
+            o_sq = (sq ^ flip) ^ mirror
+            idx = bucket * (NUM_PIECE_KINDS * NUM_SQUARES) + kind * NUM_SQUARES + o_sq
+            acc += p.ft_w[idx]
+        accs.append(acc)
+    own, opp = (accs[0], accs[1]) if stm == 0 else (accs[1], accs[0])
+    x = np.concatenate([np.clip(own, 0, 1), np.clip(opp, 0, 1)])
+    ob = min((int(np.sum(board64 > 0)) - 1) // 4, NUM_OUTPUT_BUCKETS - 1)
+    h = np.clip(x @ p.l1_w[ob] + p.l1_b[ob], 0, 1)
+    h = np.clip(h @ p.l2_w[ob] + p.l2_b[ob], 0, 1)
+    return float((h @ p.out_w[ob] + p.out_b[ob]) * OUTPUT_SCALE)
+
+
+# -------------------------------------------------------------- persistence
+
+
+def save_params(params: NnueParams, path: str | Path) -> None:
+    path = Path(path)
+    meta = {
+        "format": "fishnet-tpu-nnue-v1",
+        "feature_set": "HalfKAv2_hm",
+        "l1": int(params.ft_w.shape[1]),
+        "h1": int(params.l1_w.shape[2]),
+        "h2": int(params.l2_w.shape[2]),
+        "output_buckets": NUM_OUTPUT_BUCKETS,
+        "output_scale": OUTPUT_SCALE,
+    }
+    arrays = {f: np.asarray(getattr(params, f)) for f in NnueParams._fields}
+    np.savez_compressed(path, __meta__=json.dumps(meta), **arrays)
+
+
+def load_params(path: str | Path) -> NnueParams:
+    with np.load(Path(path), allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        if meta.get("format") != "fishnet-tpu-nnue-v1":
+            raise ValueError(f"unknown nnue format: {meta.get('format')!r}")
+        return NnueParams(**{f: jnp.asarray(z[f]) for f in NnueParams._fields})

@@ -1,0 +1,309 @@
+"""Lockstep batched alpha-beta search.
+
+The reference's "search layer" is Stockfish's recursive C++ alpha-beta run
+in one process per core (reference: §2 of SURVEY.md; fishnet drives it via
+`go nodes N` per position, src/stockfish.rs:290-350). On TPU the recursion
+becomes an explicit per-lane DFS stack advanced in lockstep by a single
+jitted `lax.while_loop` step over B independent lanes:
+
+- copy-make: child boards are written to a (B, MAX_PLY, ...) stack, so
+  there is no unmake logic on device;
+- pseudo-legal movegen + king-capture refutation: a mover that leaves the
+  king en prise is refuted at the child (ILLEGAL sentinel), which keeps
+  pin/evasion logic out of the kernel;
+- one state machine step = phase ENTER (classify node: illegal/leaf/expand
+  with movegen) → phase RETURN (fold a finished child into its parent) →
+  phase TRYMOVE (pick next move or finish the node). Phase order is chosen
+  so a leaf child costs a single step;
+- per-lane node budgets and depth limits; lanes park in DONE and are
+  masked out (divergence tax: a step costs the same while any lane runs).
+
+MultiPV and iterative deepening are driven from the host (engine/tpu.py):
+lanes are cheap, so multipv lanes are just more lanes.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import nnue
+from .board import Board, is_attacked, king_square, make_move
+from .movegen import MAX_MOVES, generate_moves
+
+INF = 32500
+MATE = 32000
+ILLEGAL = 99999  # sentinel: the move leading to this node was illegal
+DRAW = 0
+
+MODE_ENTER = 0
+MODE_RETURN = 1
+MODE_TRYMOVE = 2
+MODE_DONE = 3
+
+
+class SearchState(NamedTuple):
+    # stacks, leading dims (B, MAX_PLY[+1])
+    board: jnp.ndarray  # (B, P+1, 64) int32
+    stm: jnp.ndarray  # (B, P+1)
+    ep: jnp.ndarray  # (B, P+1)
+    castling: jnp.ndarray  # (B, P+1, 4)
+    halfmove: jnp.ndarray  # (B, P+1)
+    moves: jnp.ndarray  # (B, P, MAX_MOVES) int32
+    count: jnp.ndarray  # (B, P)
+    midx: jnp.ndarray  # (B, P)
+    searched: jnp.ndarray  # (B, P) legal children folded so far
+    alpha: jnp.ndarray  # (B, P) int32
+    beta: jnp.ndarray  # (B, P)
+    best: jnp.ndarray  # (B, P)
+    best_move: jnp.ndarray  # (B, P)
+    incheck: jnp.ndarray  # (B, P) bool
+    pv: jnp.ndarray  # (B, P, P) int32
+    pv_len: jnp.ndarray  # (B, P)
+    ply: jnp.ndarray  # (B,)
+    mode: jnp.ndarray  # (B,)
+    ret: jnp.ndarray  # (B,) value returned by just-finished node
+    nodes: jnp.ndarray  # (B,) int32 visited nodes
+    depth_limit: jnp.ndarray  # (B,)
+    node_budget: jnp.ndarray  # (B,)
+    root_score: jnp.ndarray  # (B,)
+    root_move: jnp.ndarray  # (B,)
+
+
+def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
+    return Board(
+        board=s.board[ply],
+        stm=s.stm[ply],
+        ep=s.ep[ply],
+        castling=s.castling[ply],
+        halfmove=s.halfmove[ply],
+    )
+
+
+def init_state(roots: Board, depth: jnp.ndarray, node_budget: jnp.ndarray,
+               max_ply: int) -> SearchState:
+    """roots: batched Board (B leading dim); depth/node_budget: (B,)."""
+    B = roots.stm.shape[0]
+    P = max_ply
+
+    def z(*shape, dtype=jnp.int32, fill=0):
+        return jnp.full((B, *shape), fill, dtype=dtype)
+
+    board = z(P + 1, 64)
+    board = board.at[:, 0].set(roots.board)
+    stm = z(P + 1)
+    stm = stm.at[:, 0].set(roots.stm)
+    ep = z(P + 1, fill=-1)
+    ep = ep.at[:, 0].set(roots.ep)
+    castling = z(P + 1, 4, fill=-1)
+    castling = castling.at[:, 0].set(roots.castling)
+    halfmove = z(P + 1)
+    halfmove = halfmove.at[:, 0].set(roots.halfmove)
+    return SearchState(
+        board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
+        moves=z(P, MAX_MOVES, fill=-1),
+        count=z(P), midx=z(P), searched=z(P),
+        alpha=z(P, fill=-INF), beta=z(P, fill=INF),
+        best=z(P, fill=-INF), best_move=z(P, fill=-1),
+        incheck=z(P, dtype=jnp.bool_),
+        pv=z(P, P, fill=-1), pv_len=z(P),
+        ply=z(), mode=z(), ret=z(),
+        nodes=z(),
+        depth_limit=depth.astype(jnp.int32),
+        node_budget=node_budget.astype(jnp.int32),
+        root_score=z(fill=-INF), root_move=z(fill=-1),
+    )
+
+
+def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
+    """One state-machine step for a single lane (vmapped over B)."""
+    ply = s.ply
+
+    # ---------------------------------------------------------- phase ENTER
+    def phase_enter(s):
+        b = _board_at(s, ply)
+        us = b.stm
+        them = 1 - us
+        our_k = king_square(b.board, us)
+        their_k = king_square(b.board, them)
+        # parent's move was illegal iff the side that just moved (them)
+        # left its king attacked (or captured outright)
+        parent_illegal = (ply > 0) & (
+            (their_k < 0)
+            | is_attacked(b.board, jnp.maximum(their_k, 0), us)
+        )
+        we_are_checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
+        depth_left = s.depth_limit - ply
+        over_budget = s.nodes >= s.node_budget
+        fifty = b.halfmove >= 100
+        is_leaf = (depth_left <= 0) | fifty | over_budget
+
+        # leaf value: NNUE eval (or draw for 50-move)
+        leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
+        leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
+        leaf_val = jnp.where(fifty, DRAW, leaf_val)
+
+        gen_moves, gen_count = generate_moves(b)
+
+        ret = jnp.where(parent_illegal, ILLEGAL, leaf_val)
+        to_return = parent_illegal | is_leaf
+        new_mode = jnp.where(to_return, MODE_RETURN, MODE_TRYMOVE)
+
+        expand = ~to_return
+        upd = lambda arr, val: arr.at[ply].set(jnp.where(expand, val, arr[ply]))
+        return s._replace(
+            moves=s.moves.at[ply].set(
+                jnp.where(expand, gen_moves, s.moves[ply])
+            ),
+            count=upd(s.count, gen_count),
+            midx=upd(s.midx, 0),
+            searched=upd(s.searched, 0),
+            alpha=upd(s.alpha, jnp.where(ply == 0, -INF, -s.beta[ply - 1])),
+            beta=upd(s.beta, jnp.where(ply == 0, INF, -s.alpha[ply - 1])),
+            best=upd(s.best, -INF),
+            best_move=upd(s.best_move, -1),
+            incheck=s.incheck.at[ply].set(we_are_checked),
+            # leaf nodes must also zero pv_len: the fold at the parent reads
+            # pv_len[child_ply], which would otherwise be a stale slot
+            pv_len=s.pv_len.at[ply].set(0),
+            ret=jnp.where(to_return, ret, s.ret),
+            mode=new_mode,
+            nodes=s.nodes + jnp.where(parent_illegal, 0, 1),
+        )
+
+    s = jax.lax.cond(s.mode == MODE_ENTER, phase_enter, lambda s: s, s)
+
+    # --------------------------------------------------------- phase RETURN
+    def phase_return(s):
+        # the node at `ply` finished with value s.ret (from its stm's view)
+        at_root = ply == 0
+
+        # root: record and park (ret, not best[0] — ret carries the
+        # mate/stalemate value when the root had no legal moves)
+        root_done = s._replace(
+            root_score=jnp.where(at_root, s.ret, s.root_score),
+            root_move=jnp.where(at_root, s.best_move[0], s.root_move),
+            mode=jnp.where(at_root, MODE_DONE, s.mode),
+        )
+
+        # interior: fold into parent at ply-1
+        parent = jnp.maximum(ply - 1, 0)
+        was_illegal = s.ret == ILLEGAL
+        v = -s.ret
+        tried = s.moves[parent, jnp.maximum(s.midx[parent] - 1, 0)]
+        better = (~was_illegal) & (v > s.best[parent])
+        new_best = jnp.where(better, v, s.best[parent])
+        new_best_move = jnp.where(better, tried, s.best_move[parent])
+        new_alpha = jnp.maximum(s.alpha[parent], new_best)
+        new_searched = s.searched[parent] + jnp.where(was_illegal, 0, 1)
+        # pv[parent] = tried + pv[ply]
+        child_pv = s.pv[ply]
+        new_pv_row = jnp.concatenate(
+            [tried[None], child_pv[:-1]]
+        )
+        new_pv_len = jnp.minimum(s.pv_len[ply] + 1, s.pv.shape[-1])
+
+        folded = s._replace(
+            best=s.best.at[parent].set(new_best),
+            best_move=s.best_move.at[parent].set(new_best_move),
+            alpha=s.alpha.at[parent].set(new_alpha),
+            searched=s.searched.at[parent].set(new_searched),
+            pv=jnp.where(
+                better,
+                s.pv.at[parent].set(new_pv_row),
+                s.pv,
+            ),
+            pv_len=jnp.where(
+                better, s.pv_len.at[parent].set(new_pv_len), s.pv_len
+            ),
+            ply=parent,
+            mode=MODE_TRYMOVE,
+        )
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(at_root, a, b), root_done, folded
+        )
+
+    s = jax.lax.cond(s.mode == MODE_RETURN, phase_return, lambda s: s, s)
+    ply = s.ply  # may have been decremented by RETURN
+
+    # -------------------------------------------------------- phase TRYMOVE
+    def phase_trymove(s):
+        # note: the node budget is enforced in ENTER (children degrade to
+        # leaf evals), not here — finishing a node early with searched==0
+        # would return -INF garbage to the parent
+        exhausted = s.midx[ply] >= s.count[ply]
+        cutoff = s.alpha[ply] >= s.beta[ply]
+        finish = exhausted | cutoff
+
+        # finished node value: best, or mate/stalemate when no legal child
+        no_legal = s.searched[ply] == 0
+        mate_val = jnp.where(s.incheck[ply], -(MATE - ply), DRAW)
+        fin_val = jnp.where(no_legal & exhausted, mate_val, s.best[ply])
+
+        move = s.moves[ply, jnp.minimum(s.midx[ply], MAX_MOVES - 1)]
+        parent_b = _board_at(s, ply)
+        child = make_move(parent_b, jnp.maximum(move, 0))
+        nply = ply + 1
+
+        advanced = s._replace(
+            midx=s.midx.at[ply].add(1),
+            board=s.board.at[nply].set(child.board),
+            stm=s.stm.at[nply].set(child.stm),
+            ep=s.ep.at[nply].set(child.ep),
+            castling=s.castling.at[nply].set(child.castling),
+            halfmove=s.halfmove.at[nply].set(child.halfmove),
+            ply=nply,
+            mode=MODE_ENTER,
+        )
+        finished = s._replace(ret=fin_val, mode=MODE_RETURN)
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(finish, a, b), finished, advanced
+        )
+
+    s = jax.lax.cond(s.mode == MODE_TRYMOVE, phase_trymove, lambda s: s, s)
+    return s
+
+
+def make_search_step(params: nnue.NnueParams):
+    lane_axes = SearchState(
+        *[0 for _ in SearchState._fields]
+    )
+    return jax.vmap(lambda s: _step_lane(params, s), in_axes=(lane_axes,))
+
+
+def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
+                 max_ply: int, max_steps: int = 2_000_000):
+    """Run fixed-depth alpha-beta on B root positions in lockstep.
+
+    Requires max_ply > max(depth): leaves live at ply == depth and need
+    stack slots. Returns a dict of (B,)-shaped results; scores are
+    centipawn ints from the root side to move's perspective; ±(MATE-n)
+    encodes mate in n plies.
+    """
+    B = roots.stm.shape[0]
+    depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
+    node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
+    state = init_state(roots, depth, node_budget, max_ply)
+    step = make_search_step(params)
+
+    def cond(carry):
+        s, i = carry
+        return (i < max_steps) & jnp.any(s.mode != MODE_DONE)
+
+    def body(carry):
+        s, i = carry
+        return step(s), i + 1
+
+    state, steps = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+    return {
+        "score": state.root_score,
+        "move": state.root_move,
+        "pv": state.pv[:, 0],
+        "pv_len": state.pv_len[:, 0],
+        "nodes": state.nodes,
+        "steps": steps,
+    }
+
+
+search_batch_jit = jax.jit(search_batch, static_argnames=("max_ply", "max_steps"))
