@@ -1,0 +1,80 @@
+"""Benchmark: batched alpha-beta + NNUE nodes/sec on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star metric (BASELINE.md) is nodes/sec/chip on a 256-position
+batch. vs_baseline divides by the reference client's own per-core NPS
+scheduling prior (400 knps, reference: src/stats.rs:203-214) × host cores —
+the documented proxy for "Stockfish-AVX2 on the same host" since this image
+bundles no Stockfish binary to measure directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    B = int(os.environ.get("BENCH_LANES", "256"))
+    DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
+    BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fishnet_tpu.chess import Position
+    from fishnet_tpu.models import nnue
+    from fishnet_tpu.ops.board import from_position, stack_boards
+    from fishnet_tpu.ops.search import search_batch_jit
+
+    # a spread of real game positions (openings → endgames)
+    fens = [
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+        "rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8",
+        "r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10",
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+        "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
+        "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
+    ]
+    positions = [Position.from_fen(f) for f in fens]
+    lanes = [from_position(positions[i % len(positions)]) for i in range(B)]
+    roots = stack_boards(lanes)
+    params = nnue.init_params(jax.random.PRNGKey(0), l1=64)
+
+    max_ply = DEPTH + 1
+    depth = jnp.full((B,), DEPTH, jnp.int32)
+    budget = jnp.full((B,), BUDGET, jnp.int32)
+
+    # warmup / compile
+    out = search_batch_jit(params, roots, depth, budget, max_ply=max_ply)
+    jax.block_until_ready(out["nodes"])
+
+    t0 = time.perf_counter()
+    out = search_batch_jit(params, roots, depth, budget, max_ply=max_ply)
+    jax.block_until_ready(out["nodes"])
+    dt = time.perf_counter() - t0
+
+    total_nodes = int(np.asarray(out["nodes"]).sum())
+    nps = total_nodes / dt
+
+    cores = os.cpu_count() or 1
+    baseline = 400_000 * cores  # reference NPS prior × host cores
+    print(
+        json.dumps(
+            {
+                "metric": f"batched alpha-beta+NNUE nodes/sec/chip (B={B}, depth={DEPTH})",
+                "value": round(nps),
+                "unit": "nodes/sec",
+                "vs_baseline": round(nps / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,249 @@
+"""Config: CLI flags, fishnet.ini, interactive dialog; precedence CLI > ini.
+
+Parity with the reference's three-layer config system (reference:
+src/configure.rs:20-643): same flags, same ini file format (default section
+"Fishnet"), same subcommands (run | configure | systemd | systemd-user |
+license), same human duration parsing (d/h/m/s/ms), same Cores/Backlog
+semantics — plus the TPU backend's own knobs (backend selection, weight
+file, engine paths for the subprocess fallback).
+"""
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import List, Optional
+
+_DURATION_RE = re.compile(r"^\s*(\d+)\s*(d|h|m|s|ms)?\s*$")
+
+
+def parse_duration(text: str) -> float:
+    """'90s', '2m', '1h', '1d', '500ms', bare seconds → seconds
+    (reference: src/configure.rs:340-355)."""
+    m = _DURATION_RE.match(text)
+    if not m:
+        raise ValueError(f"invalid duration: {text!r}")
+    value = int(m.group(1))
+    unit = m.group(2) or "s"
+    scale = {"d": 86400, "h": 3600, "m": 60, "s": 1, "ms": 0.001}[unit]
+    return value * scale
+
+
+def parse_cores(text: Optional[str]) -> int:
+    """'auto' = n-1, 'all'/'max' = n, or an explicit number
+    (reference: src/configure.rs:177-219)."""
+    n = os.cpu_count() or 1
+    if text is None or text == "auto":
+        return max(n - 1, 1)
+    if text in ("all", "max"):
+        return n
+    value = int(text)
+    if value < 1:
+        raise ValueError("cores must be >= 1")
+    return min(value, n)
+
+
+def parse_backlog(text: Optional[str]) -> Optional[float]:
+    """'short' = 30s, 'long' = 1h, duration, or None
+    (reference: src/configure.rs:244-289)."""
+    if text is None or text == "":
+        return None
+    if text == "short":
+        return 30.0
+    if text == "long":
+        return 3600.0
+    return parse_duration(text)
+
+
+def validate_key(key: str) -> str:
+    key = key.strip()
+    if not key:
+        return key
+    if not key.isalnum():
+        raise ValueError("fishnet key must be alphanumeric")
+    return key
+
+
+@dataclass
+class Config:
+    command: str = "run"
+    endpoint: str = "https://lichess.org/fishnet"
+    key: Optional[str] = None
+    key_file: Optional[str] = None
+    cores: int = 1
+    backend: str = "tpu"  # tpu | subprocess | python
+    engine_path: Optional[str] = None  # external Stockfish (Official flavor)
+    variant_engine_path: Optional[str] = None  # external Fairy-Stockfish
+    tpu_weights: Optional[str] = None
+    tpu_depth: int = 6
+    user_backlog: Optional[float] = None
+    system_backlog: Optional[float] = None
+    max_backoff: float = 30.0
+    cpu_priority: Optional[str] = None
+    stats_file: Optional[str] = None
+    no_stats_file: bool = False
+    auto_update: bool = False
+    conf: Optional[str] = None
+    no_conf: bool = False
+    verbose: int = 0
+    extra_args: List[str] = field(default_factory=list)
+
+    def resolved_key(self) -> Optional[str]:
+        if self.key:
+            return self.key
+        if self.key_file:
+            return Path(self.key_file).read_text().strip()
+        return None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fishnet-tpu",
+        description="Distributed analysis client for lichess.org with a TPU engine",
+    )
+    p.add_argument("command", nargs="?", default="run",
+                   choices=["run", "configure", "systemd", "systemd-user", "license", "bench"])
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    p.add_argument("--auto-update", action="store_true")
+    p.add_argument("--conf", help="path to fishnet.ini")
+    p.add_argument("--no-conf", action="store_true")
+    p.add_argument("--key", help="fishnet key")
+    p.add_argument("--key-file", help="file containing the fishnet key")
+    p.add_argument("--endpoint", help="API endpoint")
+    p.add_argument("--cores", help="number of workers: auto, all, or a number")
+    p.add_argument("--backend", choices=["tpu", "subprocess", "python"],
+                   help="analysis backend (default tpu)")
+    p.add_argument("--engine-path", help="external Stockfish binary (subprocess backend)")
+    p.add_argument("--variant-engine-path", help="external Fairy-Stockfish binary")
+    p.add_argument("--tpu-weights", help="NNUE weights file (.npz)")
+    p.add_argument("--tpu-depth", type=int, help="max search depth for the TPU engine")
+    p.add_argument("--user-backlog", help="short, long, or duration")
+    p.add_argument("--system-backlog", help="short, long, or duration")
+    p.add_argument("--max-backoff", help="maximum backoff duration")
+    p.add_argument("--cpu-priority", choices=["min", "normal"])
+    p.add_argument("--stats-file")
+    p.add_argument("--no-stats-file", action="store_true")
+    return p
+
+
+INI_SECTION = "Fishnet"  # reference: src/configure.rs:421
+
+
+def read_ini(path: Path) -> dict:
+    parser = configparser.ConfigParser()
+    parser.read(path)
+    if parser.has_section(INI_SECTION):
+        return dict(parser.items(INI_SECTION))
+    # tolerate files without a section header
+    try:
+        with open(path) as f:
+            content = f"[{INI_SECTION}]\n" + f.read()
+        parser = configparser.ConfigParser()
+        parser.read_string(content)
+        return dict(parser.items(INI_SECTION))
+    except (OSError, configparser.Error):
+        return {}
+
+
+def write_ini(path: Path, values: dict) -> None:
+    parser = configparser.ConfigParser()
+    parser[INI_SECTION] = {k: str(v) for k, v in values.items() if v is not None}
+    with open(path, "w") as f:
+        parser.write(f)
+
+
+def merge(args: argparse.Namespace, ini: dict) -> Config:
+    """CLI wins over ini (reference: src/configure.rs:602-627)."""
+
+    def pick(cli_value, ini_key, default=None):
+        if cli_value is not None and cli_value is not False:
+            return cli_value
+        if ini_key in ini and ini[ini_key] != "":
+            return ini[ini_key]
+        return default
+
+    cfg = Config()
+    cfg.command = args.command
+    cfg.verbose = args.verbose
+    cfg.auto_update = bool(pick(args.auto_update or None, "auto_update", False))
+    cfg.endpoint = str(pick(args.endpoint, "endpoint", cfg.endpoint)).rstrip("/")
+    key = pick(args.key, "key")
+    cfg.key = validate_key(str(key)) if key else None
+    cfg.key_file = pick(args.key_file, "key_file")
+    cfg.cores = parse_cores(pick(args.cores, "cores"))
+    cfg.backend = str(pick(args.backend, "backend", "tpu"))
+    cfg.engine_path = pick(args.engine_path, "engine_path")
+    cfg.variant_engine_path = pick(args.variant_engine_path, "variant_engine_path")
+    cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
+    cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", 6))
+    cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
+    cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
+    cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))
+    cfg.cpu_priority = pick(args.cpu_priority, "cpu_priority")
+    cfg.stats_file = pick(args.stats_file, "stats_file")
+    cfg.no_stats_file = bool(args.no_stats_file)
+    cfg.conf = args.conf
+    cfg.no_conf = args.no_conf
+    return cfg
+
+
+def interactive_dialog(cfg: Config, check_key=None, stream=sys.stdout) -> Config:
+    """The reference's 5-step first-run dialog (reference:
+    src/configure.rs:433-600): endpoint, key (with optional online
+    validation), cores, backlog, write fishnet.ini."""
+
+    def ask(prompt: str, default: str = "") -> str:
+        suffix = f" ({default})" if default else ""
+        stream.write(f"{prompt}{suffix}: ")
+        stream.flush()
+        line = input().strip()
+        return line or default
+
+    endpoint = ask("Endpoint", cfg.endpoint).rstrip("/")
+    key = ask("Personal fishnet key (https://lichess.org/get-fishnet)", cfg.key or "")
+    key = validate_key(key)
+    if key and check_key is not None and not check_key(endpoint, key):
+        raise ValueError("key rejected by server")
+    cores = ask("Number of logical cores to use", "auto")
+    backlog = ask(
+        "Analysis backlog: short (user games), long (system), or duration", ""
+    )
+    cfg.endpoint = endpoint
+    cfg.key = key or None
+    cfg.cores = parse_cores(cores if cores != "auto" else None)
+    cfg.user_backlog = parse_backlog(backlog or None)
+    target = ask("Write configuration to", str(Path("fishnet.ini").absolute()))
+    write_ini(
+        Path(target),
+        {
+            "endpoint": cfg.endpoint,
+            "key": cfg.key,
+            "cores": cfg.cores,
+            "user_backlog": backlog or None,
+        },
+    )
+    return cfg
+
+
+def parse_and_configure(argv: Optional[List[str]] = None, interactive: bool = True,
+                        check_key=None) -> Config:
+    args = build_parser().parse_args(argv)
+    ini: dict = {}
+    conf_path = Path(args.conf) if args.conf else Path("fishnet.ini")
+    if not args.no_conf and conf_path.exists():
+        ini = read_ini(conf_path)
+    cfg = merge(args, ini)
+    needs_dialog = args.command == "configure" or (
+        interactive
+        and not args.no_conf
+        and not conf_path.exists()
+        and args.command == "run"
+        and sys.stdin.isatty()
+    )
+    if needs_dialog:
+        cfg = interactive_dialog(cfg, check_key=check_key)
+    return cfg

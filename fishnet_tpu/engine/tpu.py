@@ -1,0 +1,274 @@
+"""The TPU batch engine: chunks in, PositionResponses out.
+
+Replaces the reference's engine subprocess + UCI dialogue (reference:
+src/stockfish.rs:222-465) with a host→device dispatch: all positions of a
+chunk (and all multipv root moves) become lanes of one lockstep
+alpha-beta search. Iterative deepening runs host-side, filling the same
+multipv×depth score/pv matrices the UCI parser would have accumulated.
+
+Lane counts are padded to fixed buckets so XLA compiles a handful of
+program shapes, then caches.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chess.position import Position
+from ..chess.variants import from_fen
+from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
+from ..client.wire import AnalysisWork, MoveWork, Score
+from ..models import nnue
+from ..ops.board import from_position, stack_boards
+from ..ops.search import MATE, search_batch_jit
+from .base import EngineError
+
+MAX_PLY = 24  # static stack depth; supports search depths up to 23
+LANE_BUCKETS = (8, 16, 32, 64, 128, 256)
+
+
+def _decode_uci(m: int) -> str:
+    frm, to, promo = m & 63, (m >> 6) & 63, (m >> 12) & 7
+    s = (
+        "abcdefgh"[frm & 7] + str((frm >> 3) + 1)
+        + "abcdefgh"[to & 7] + str((to >> 3) + 1)
+    )
+    if promo:
+        s += " nbrq"[promo]
+    return s
+
+
+def _score_from_int(v: int, root_ply_to_mate_sign: int = 1) -> Score:
+    if v >= MATE - 1000:
+        return Score.mate((MATE - v + 1) // 2)
+    if v <= -(MATE - 1000):
+        return Score.mate(-((MATE + v + 1) // 2))
+    return Score.cp(int(v))
+
+
+def _pad_lanes(n: int) -> int:
+    for b in LANE_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + 255) // 256) * 256
+
+
+class TpuEngine:
+    """Batched analysis engine. `variants` lists what it accepts (the
+    planner routes only those here — client/planner.py tpu_variants)."""
+
+    def __init__(
+        self,
+        params: Optional[nnue.NnueParams] = None,
+        weights_path: Optional[str] = None,
+        max_depth: int = 6,
+        seed: int = 1234,
+    ) -> None:
+        if params is None:
+            if weights_path:
+                params = nnue.load_params(weights_path)
+            else:
+                params = nnue.init_params(jax.random.PRNGKey(seed), l1=64)
+        self.params = params
+        self.max_depth = max_depth
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self._go_multiple_sync, chunk)
+        except EngineError:
+            raise
+        except Exception as e:  # device/compile errors surface as EngineError
+            raise EngineError(f"tpu engine failed: {e}") from e
+
+    async def close(self) -> None:
+        pass
+
+    # ----------------------------------------------------------------- sync
+
+    def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
+        started = time.monotonic()
+        positions = []
+        for wp in chunk.positions:
+            pos = from_fen(wp.root_fen, chunk.variant)
+            for uci in wp.moves:
+                pos = pos.push(pos.parse_uci(uci))
+            positions.append(pos)
+
+        work = chunk.work
+        if isinstance(work, AnalysisWork):
+            multipv = work.effective_multipv()
+            target_depth = min(work.depth or self.max_depth, self.max_depth, MAX_PLY - 1)
+            budget = work.nodes.get(chunk.flavor.eval_flavor())
+        else:
+            assert isinstance(work, MoveWork)
+            multipv = 1
+            target_depth = min(work.level.depth, self.max_depth, MAX_PLY - 1)
+            budget = None
+
+        if multipv > 1:
+            responses = self._analyse_multipv(
+                chunk, positions, multipv, target_depth, budget, started
+            )
+        else:
+            responses = self._analyse_single(
+                chunk, positions, target_depth, budget, started
+            )
+        return responses
+
+    def _terminal_response(self, chunk, wp: WorkPosition, pos: Position,
+                           elapsed: float) -> PositionResponse:
+        winner, _ = pos.outcome()
+        scores, pvs = Matrix(), Matrix()
+        scores.set(1, 0, Score.mate(0) if winner is not None else Score.cp(0))
+        pvs.set(1, 0, [])
+        return PositionResponse(
+            work=chunk.work, position_index=wp.position_index, url=wp.url,
+            scores=scores, pvs=pvs, best_move=None, depth=0, nodes=0,
+            time_s=elapsed,
+        )
+
+    def _analyse_single(self, chunk, positions, target_depth, budget, started):
+        terminal = {
+            i for i, p in enumerate(positions) if p.outcome() is not None
+        }
+        lanes = [i for i in range(len(positions)) if i not in terminal]
+
+        scores = [Matrix() for _ in positions]
+        pvs = [Matrix() for _ in positions]
+        depth_reached = [0] * len(positions)
+        best_moves: List[Optional[str]] = [None] * len(positions)
+        nodes_total = [0] * len(positions)
+
+        if lanes:
+            B = _pad_lanes(len(lanes))
+            boards = [from_position(positions[i]) for i in lanes]
+            pad = from_position(positions[lanes[0]])
+            roots = stack_boards(boards + [pad] * (B - len(boards)))
+            per_pos_budget = budget if budget is not None else 10_000_000
+            remaining = np.full(B, per_pos_budget, dtype=np.int64)
+
+            for depth in range(1, target_depth + 1):
+                depth_arr = np.zeros(B, np.int32)
+                depth_arr[: len(lanes)] = depth
+                budget_arr = np.clip(remaining, 0, 2**31 - 1).astype(np.int32)
+                out = search_batch_jit(
+                    self.params, roots, jnp.asarray(depth_arr),
+                    jnp.asarray(budget_arr), max_ply=MAX_PLY,
+                )
+                out = {k: np.asarray(v) for k, v in out.items()}
+                exhausted_all = True
+                for j, i in enumerate(lanes):
+                    if remaining[j] <= 0:
+                        continue
+                    nodes_total[i] += int(out["nodes"][j])
+                    remaining[j] -= int(out["nodes"][j])
+                    sc = int(out["score"][j])
+                    scores[i].set(1, depth, _score_from_int(sc))
+                    pv = [
+                        _decode_uci(int(m))
+                        for m in out["pv"][j][: int(out["pv_len"][j])]
+                        if m >= 0
+                    ]
+                    pvs[i].set(1, depth, pv)
+                    depth_reached[i] = depth
+                    mv = int(out["move"][j])
+                    best_moves[i] = _decode_uci(mv) if mv >= 0 else None
+                    if remaining[j] > 0:
+                        exhausted_all = False
+                if exhausted_all:
+                    break
+
+        elapsed = max(time.monotonic() - started, 1e-6)
+        per_pos_time = elapsed / max(len(positions), 1)
+        responses = []
+        for i, wp in enumerate(chunk.positions):
+            if i in terminal:
+                responses.append(
+                    self._terminal_response(chunk, wp, positions[i], per_pos_time)
+                )
+                continue
+            nps = int(nodes_total[i] / per_pos_time) if per_pos_time > 0 else None
+            responses.append(
+                PositionResponse(
+                    work=chunk.work, position_index=wp.position_index,
+                    url=wp.url, scores=scores[i], pvs=pvs[i],
+                    best_move=best_moves[i], depth=depth_reached[i],
+                    nodes=nodes_total[i], time_s=per_pos_time, nps=nps,
+                )
+            )
+        return responses
+
+    def _analyse_multipv(self, chunk, positions, multipv, target_depth,
+                         budget, started):
+        """MultiPV via root-move lanes: every legal root move of every
+        position becomes a lane searched at depth-1."""
+        responses = []
+        elapsed_base = time.monotonic()
+        for wp, pos in zip(chunk.positions, positions):
+            t0 = time.monotonic()
+            if pos.outcome() is not None:
+                responses.append(
+                    self._terminal_response(chunk, wp, pos, 0.001)
+                )
+                continue
+            legal = pos.legal_moves()
+            children = [pos.push(m) for m in legal]
+            B = _pad_lanes(len(children))
+            boards = [from_position(c) for c in children]
+            roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+
+            scores, pvs = Matrix(), Matrix()
+            nodes_total = 0
+            depth_reached = 0
+            best_move = None
+            per_pos_budget = budget if budget is not None else 10_000_000
+            remaining = per_pos_budget
+
+            for depth in range(1, target_depth + 1):
+                depth_arr = np.zeros(B, np.int32)
+                depth_arr[: len(children)] = depth - 1
+                share = max(remaining // max(len(children), 1), 1)
+                out = search_batch_jit(
+                    self.params, roots,
+                    jnp.asarray(depth_arr),
+                    jnp.asarray(np.full(B, min(share, 2**31 - 1), np.int32)),
+                    max_ply=MAX_PLY,
+                )
+                out = {k: np.asarray(v) for k, v in out.items()}
+                step_nodes = int(out["nodes"][: len(children)].sum()) + len(children)
+                nodes_total += step_nodes
+                remaining -= step_nodes
+                ranked = []
+                for j, m in enumerate(legal):
+                    child_score = -int(out["score"][j])
+                    child_pv = [
+                        _decode_uci(int(x))
+                        for x in out["pv"][j][: int(out["pv_len"][j])]
+                        if x >= 0
+                    ]
+                    ranked.append((child_score, m.uci(), [m.uci()] + child_pv))
+                ranked.sort(key=lambda t: -t[0])
+                for rank, (sc, _mv, line) in enumerate(ranked[:multipv], start=1):
+                    scores.set(rank, depth, _score_from_int(sc))
+                    pvs.set(rank, depth, line)
+                depth_reached = depth
+                best_move = ranked[0][1]
+                if remaining <= 0:
+                    break
+
+            dt = max(time.monotonic() - t0, 1e-6)
+            responses.append(
+                PositionResponse(
+                    work=chunk.work, position_index=wp.position_index,
+                    url=wp.url, scores=scores, pvs=pvs, best_move=best_move,
+                    depth=depth_reached, nodes=nodes_total, time_s=dt,
+                    nps=int(nodes_total / dt),
+                )
+            )
+        return responses

@@ -1,0 +1,192 @@
+"""UCI subprocess engine adapter.
+
+Behavioral parity with the reference's Stockfish actor (reference:
+src/stockfish.rs:18-465): spawn in own process group (^C must not reach the
+engine), init with UCI_Chess960=true + isready, per-chunk option setup
+(MultiVariant: Use NNUE / UCI_AnalyseMode / UCI_Variant; always MultiPV and
+Skill Level), per-position `position fen … moves …` + `go …`, and parse
+`info`/`bestmove` into the multipv×depth score/pv matrices.
+
+This framework bundles no engine binaries (weights are the asset, not
+executables — see assets.py); this adapter exists for capability parity
+when the operator points it at an external Stockfish/Fairy-Stockfish build,
+and doubles as the reference-oracle hook for cross-checking the TPU engine.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import List, Optional
+
+from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
+from ..client.wire import AnalysisWork, EngineFlavor, MoveWork
+from ..client.wire import Score
+from .base import EngineError
+
+# lichess variant key → UCI_Variant value (reference: shakmaty Variant::uci)
+UCI_VARIANT_NAMES = {
+    "standard": "chess",
+    "chess960": "chess",
+    "fromPosition": "chess",
+    "crazyhouse": "crazyhouse",
+    "antichess": "antichess",
+    "atomic": "atomic",
+    "horde": "horde",
+    "kingOfTheHill": "kingofthehill",
+    "racingKings": "racingkings",
+    "threeCheck": "3check",
+}
+
+
+class UciEngine:
+    def __init__(self, exe_path: str, logger=None, flavor: EngineFlavor = EngineFlavor.OFFICIAL):
+        self.exe_path = exe_path
+        self.logger = logger
+        self.flavor = flavor
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._initialized = False
+
+    async def _ensure_started(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            return
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                self.exe_path,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.DEVNULL,
+                # own process group so ^C at the client doesn't kill the
+                # engine mid-chunk (reference: src/stockfish.rs:97-113)
+                start_new_session=True,
+            )
+        except OSError as e:
+            raise EngineError(f"failed to spawn {self.exe_path}: {e}") from e
+        self._initialized = False
+
+    async def _send(self, line: str) -> None:
+        assert self.proc is not None and self.proc.stdin is not None
+        self.proc.stdin.write(line.encode() + b"\n")
+        await self.proc.stdin.drain()
+
+    async def _read_line(self) -> str:
+        assert self.proc is not None and self.proc.stdout is not None
+        raw = await self.proc.stdout.readline()
+        if not raw:
+            raise EngineError("engine closed stdout")
+        return raw.decode(errors="replace").rstrip("\r\n")
+
+    async def _init_dialogue(self) -> None:
+        if self._initialized:
+            return
+        await self._send("setoption name UCI_Chess960 value true")
+        await self._send("isready")
+        while True:
+            line = await self._read_line()
+            if line.strip() == "readyok":
+                break
+        self._initialized = True
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        try:
+            await self._ensure_started()
+            await self._init_dialogue()
+            await self._send("ucinewgame")
+            work = chunk.work
+            if chunk.flavor is EngineFlavor.MULTI_VARIANT:
+                nnue = chunk.flavor.eval_flavor().value == "nnue"
+                await self._send(f"setoption name Use NNUE value {str(nnue).lower()}")
+                analyse = isinstance(work, AnalysisWork)
+                await self._send(
+                    f"setoption name UCI_AnalyseMode value {str(analyse).lower()}"
+                )
+                variant = UCI_VARIANT_NAMES.get(chunk.variant, chunk.variant)
+                await self._send(f"setoption name UCI_Variant value {variant}")
+            await self._send(
+                f"setoption name MultiPV value {work.effective_multipv()}"
+            )
+            skill = 20 if isinstance(work, AnalysisWork) else work.level.engine_skill_level
+            await self._send(f"setoption name Skill Level value {skill}")
+
+            responses = []
+            for wp in chunk.positions:
+                responses.append(await self._go(chunk, wp))
+            return responses
+        except (OSError, asyncio.IncompleteReadError) as e:
+            raise EngineError(str(e)) from e
+
+    async def _go(self, chunk: Chunk, wp: WorkPosition) -> PositionResponse:
+        work = chunk.work
+        moves = " ".join(wp.moves)
+        await self._send(f"position fen {wp.root_fen} moves {moves}")
+        if isinstance(work, MoveWork):
+            go = (
+                f"go movetime {work.level.movetime_ms} depth {work.level.depth}"
+            )
+            if work.clock is not None:
+                wtime = work.clock.wtime_centis * 10
+                btime = work.clock.btime_centis * 10
+                inc = work.clock.inc_seconds * 1000
+                go += f" wtime {wtime} btime {btime} winc {inc} binc {inc}"
+        else:
+            assert isinstance(work, AnalysisWork)
+            go = f"go nodes {work.nodes.get(chunk.flavor.eval_flavor())}"
+            if work.depth is not None:
+                go += f" depth {work.depth}"
+        await self._send(go)
+
+        scores = Matrix()
+        pvs = Matrix()
+        depth = 0
+        multipv = 1
+        time_s = 0.0
+        nodes = 0
+        nps = None
+        while True:
+            line = await self._read_line()
+            parts = line.split(" ")
+            if parts[0] == "bestmove":
+                if scores.best() is None:
+                    raise EngineError("missing score in engine output")
+                best_move = parts[1] if len(parts) > 1 and parts[1] != "(none)" else None
+                return PositionResponse(
+                    work=work, position_index=wp.position_index, url=wp.url,
+                    scores=scores, pvs=pvs, best_move=best_move, depth=depth,
+                    nodes=nodes, time_s=time_s, nps=nps,
+                )
+            if parts[0] != "info":
+                continue
+            it = iter(parts[1:])
+            for tok in it:
+                if tok == "multipv":
+                    multipv = int(next(it))
+                elif tok == "depth":
+                    depth = int(next(it))
+                elif tok == "nodes":
+                    nodes = int(next(it))
+                elif tok == "time":
+                    time_s = int(next(it)) / 1000.0
+                elif tok == "nps":
+                    nps = int(next(it))
+                elif tok == "score":
+                    kind = next(it)
+                    value = int(next(it))
+                    if kind == "cp":
+                        scores.set(multipv, depth, Score.cp(value))
+                    elif kind == "mate":
+                        scores.set(multipv, depth, Score.mate(value))
+                    else:
+                        raise EngineError(f"expected cp or mate, got {kind!r}")
+                elif tok == "pv":
+                    pvs.set(multipv, depth, list(it))
+
+    async def close(self) -> None:
+        if self.proc is None:
+            return
+        proc, self.proc = self.proc, None
+        try:
+            if proc.returncode is None:
+                proc.kill()
+            await proc.wait()
+        except ProcessLookupError:
+            pass

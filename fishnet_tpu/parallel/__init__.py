@@ -1,0 +1,1 @@
+"""Device-mesh parallelism: sharded search dispatch and training."""

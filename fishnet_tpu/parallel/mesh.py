@@ -1,0 +1,71 @@
+"""Mesh construction and sharded dispatch.
+
+The reference scales within a host by one engine process per core
+(reference: src/main.rs:151-161) and across hosts by server-mediated work
+stealing. Here the within-host axis is a `jax.sharding.Mesh`: search lanes
+are embarrassingly parallel, so the batch dimension shards over all chips
+("dp"), with NNUE weights replicated in every chip's HBM — collectives only
+appear in training (psum of grads over dp, all_gather over tp).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def make_2d_mesh(dp: int, tp: int) -> Mesh:
+    devices = np.array(jax.devices()[: dp * tp]).reshape(dp, tp)
+    return Mesh(devices, ("dp", "tp"))
+
+
+def shard_batch(mesh: Mesh, tree, axis: str = "dp"):
+    """Place a pytree of batched arrays with the leading dim sharded."""
+
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def replicate(mesh: Mesh, tree):
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def sharded_search(params, roots, depth, node_budget, max_ply: int,
+                   mesh: Optional[Mesh] = None):
+    """Run the batched search with lanes sharded across the mesh.
+
+    The search program is identical to the single-chip one; XLA partitions
+    the lane dimension and runs each shard locally — no collectives are
+    needed until results are gathered back to host.
+    """
+    from ..ops.search import search_batch_jit
+
+    mesh = mesh or make_mesh()
+    import jax.numpy as jnp
+
+    B = int(roots.stm.shape[0])
+    n = mesh.devices.size
+    if B % n != 0:
+        raise ValueError(f"lane count {B} must divide over {n} devices")
+    depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
+    node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
+    roots = shard_batch(mesh, roots)
+    depth = shard_batch(mesh, depth)
+    node_budget = shard_batch(mesh, node_budget)
+    params = replicate(mesh, params)
+    return search_batch_jit(params, roots, depth, node_budget, max_ply=max_ply)

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Minimal fake UCI engine for adapter tests: legal play via the host rules
+library, fixed shallow 'analysis', standard info/bestmove output."""
+import sys
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from fishnet_tpu.chess.variants import from_fen  # noqa: E402
+
+
+def main():
+    position = None
+    variant = "standard"
+    multipv = 1
+    out = sys.stdout
+    for raw in sys.stdin:
+        line = raw.strip()
+        if line == "quit":
+            return
+        if line == "isready":
+            print("readyok", flush=True)
+        elif line.startswith("setoption name UCI_Variant value "):
+            uci_name = line.rsplit(" ", 1)[1]
+            variant = {
+                "chess": "standard", "3check": "threeCheck",
+                "kingofthehill": "kingOfTheHill", "racingkings": "racingKings",
+            }.get(uci_name, uci_name)
+        elif line.startswith("setoption name MultiPV value "):
+            multipv = int(line.rsplit(" ", 1)[1])
+        elif line.startswith("position fen "):
+            rest = line[len("position fen "):]
+            if " moves " in rest:
+                fen, moves_s = rest.split(" moves ", 1)
+                moves = moves_s.split()
+            else:
+                fen, moves = rest, []
+            # trailing "moves" with no moves
+            fen = fen.rsplit(" moves", 1)[0] if fen.endswith(" moves") else fen
+            position = from_fen(fen.strip(), variant)
+            for uci in moves:
+                position = position.push(position.parse_uci(uci))
+        elif line.startswith("go"):
+            legal = position.legal_moves() if position else []
+            if not legal:
+                print("info depth 0 score mate 0", flush=True)
+                print("bestmove (none)", flush=True)
+                continue
+            for rank, move in enumerate(legal[:multipv], start=1):
+                print(
+                    f"info depth 1 seldepth 1 multipv {rank} score cp {10 * rank} "
+                    f"nodes {len(legal)} nps 1000 time 1 pv {move.uci()}",
+                    flush=True,
+                )
+            print(f"bestmove {legal[0].uci()}", flush=True)
+    return
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""TPU engine tests: chunk in, protocol-complete responses out."""
+import asyncio
+import time
+
+import pytest
+
+from fishnet_tpu.chess import Position
+from fishnet_tpu.client.ipc import Chunk, WorkPosition
+from fishnet_tpu.client.wire import (
+    AnalysisWork,
+    EngineFlavor,
+    MoveWork,
+    NodeLimit,
+    SkillLevel,
+)
+from fishnet_tpu.engine.tpu import TpuEngine
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+GAME = ["e2e4", "c7c5", "g1f3", "d7d6"]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return TpuEngine(max_depth=3)
+
+
+def make_chunk(work, n_positions=3, moves=GAME, variant="standard"):
+    positions = [
+        WorkPosition(
+            work=work, position_index=i, url=None, skip=False,
+            root_fen=START, moves=moves[:i],
+        )
+        for i in range(n_positions)
+    ]
+    return Chunk(
+        work=work, deadline=time.monotonic() + 120, variant=variant,
+        flavor=EngineFlavor.TPU, positions=positions,
+    )
+
+
+def analysis_work(depth=3, multipv=None):
+    return AnalysisWork(
+        id="tpujob01",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0,
+        depth=depth,
+        multipv=multipv,
+    )
+
+
+def run(engine, chunk):
+    return asyncio.run(engine.go_multiple(chunk))
+
+
+def test_analysis_chunk(engine):
+    responses = run(engine, make_chunk(analysis_work(depth=3)))
+    assert len(responses) == 3
+    for i, res in enumerate(responses):
+        assert res.position_index == i
+        assert res.depth == 3
+        assert res.nodes > 0
+        best_score = res.scores.best()
+        assert best_score is not None and best_score.kind in ("cp", "mate")
+        # per-depth rows populated for depths 1..3
+        assert res.scores.matrix[0][1] is not None
+        assert res.scores.matrix[0][3] is not None
+        # pv must be a legal line from the position
+        pos = Position.from_fen(START)
+        for uci in GAME[:i]:
+            pos = pos.push(pos.parse_uci(uci))
+        pv = res.pvs.best()
+        assert pv, "empty pv"
+        for uci in pv:
+            pos = pos.push(pos.parse_uci(uci))
+        assert res.best_move == pv[0]
+
+
+def test_multipv_chunk(engine):
+    responses = run(engine, make_chunk(analysis_work(depth=2, multipv=3), n_positions=2))
+    for res in responses:
+        assert len(res.scores.matrix) == 3  # three ranked rows
+        # rank 1 must be >= rank 2 >= rank 3 at the final depth
+        def val(rank):
+            s = res.scores.matrix[rank][-1]
+            return (1000000 - s.value) if s.kind == "mate" and s.value > 0 else (
+                -1000000 - s.value if s.kind == "mate" else s.value
+            )
+        assert val(0) >= val(1) >= val(2)
+
+
+def test_terminal_position(engine):
+    # fool's mate final position: mate 0 at depth 0
+    moves = ["f2f3", "e7e5", "g2g4", "d8h4"]
+    work = analysis_work(depth=3)
+    positions = [
+        WorkPosition(work=work, position_index=0, url=None, skip=False,
+                     root_fen=START, moves=moves)
+    ]
+    chunk = Chunk(work=work, deadline=time.monotonic() + 60,
+                  variant="standard", flavor=EngineFlavor.TPU, positions=positions)
+    (res,) = run(engine, chunk)
+    assert res.depth == 0
+    assert res.scores.best().kind == "mate" and res.scores.best().value == 0
+    assert res.best_move is None
+
+
+def test_mate_in_one_found(engine):
+    work = analysis_work(depth=2)
+    positions = [
+        WorkPosition(work=work, position_index=0, url=None, skip=False,
+                     root_fen="6k1/5ppp/8/8/8/8/8/4R2K w - - 0 1", moves=[])
+    ]
+    chunk = Chunk(work=work, deadline=time.monotonic() + 60,
+                  variant="standard", flavor=EngineFlavor.TPU, positions=positions)
+    (res,) = run(engine, chunk)
+    assert res.best_move == "e1e8"
+    assert res.scores.best().kind == "mate" and res.scores.best().value == 1
+
+
+def test_move_job(engine):
+    work = MoveWork(id="tpumv001", level=SkillLevel(8))
+    positions = [
+        WorkPosition(work=work, position_index=0, url=None, skip=False,
+                     root_fen=START, moves=["e2e4", "e7e5"])
+    ]
+    chunk = Chunk(work=work, deadline=time.monotonic() + 60,
+                  variant="standard", flavor=EngineFlavor.TPU, positions=positions)
+    (res,) = run(engine, chunk)
+    pos = Position.from_fen(START).push_uci("e2e4").push_uci("e7e5")
+    legal = {m.uci() for m in pos.legal_moves()}
+    assert res.best_move in legal

@@ -1,0 +1,103 @@
+"""UCI subprocess adapter tests against the fake UCI engine."""
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from fishnet_tpu.client.ipc import Chunk, WorkPosition
+from fishnet_tpu.client.wire import (
+    AnalysisWork,
+    EngineFlavor,
+    MoveWork,
+    NodeLimit,
+    SkillLevel,
+)
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.uci import UciEngine
+
+FAKE = os.path.join(os.path.dirname(__file__), "fake_uci.py")
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+class FakeUci(UciEngine):
+    """Run the fake engine via the current interpreter."""
+
+    async def _ensure_started(self):
+        if self.proc is not None and self.proc.returncode is None:
+            return
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable, FAKE,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        self._initialized = False
+
+
+def chunk_of(work, flavor, positions, variant="standard"):
+    return Chunk(work=work, deadline=time.monotonic() + 30, variant=variant,
+                 flavor=flavor, positions=positions)
+
+
+def test_analysis_dialogue():
+    async def main():
+        engine = FakeUci("unused")
+        work = AnalysisWork(
+            id="ucijob01", nodes=NodeLimit(sf16=100000, classical=200000),
+            timeout_s=10.0, multipv=2,
+        )
+        positions = [
+            WorkPosition(work=work, position_index=i, url=None, skip=False,
+                         root_fen=START, moves=["e2e4"][:i])
+            for i in range(2)
+        ]
+        res = await engine.go_multiple(
+            chunk_of(work, EngineFlavor.OFFICIAL, positions)
+        )
+        await engine.close()
+        return res
+
+    res = asyncio.run(main())
+    assert len(res) == 2
+    for r in res:
+        assert r.scores.best() is not None
+        assert r.best_move is not None
+        assert len(r.scores.matrix) == 2  # multipv 2
+
+
+def test_move_dialogue_and_variant_options():
+    async def main():
+        engine = FakeUci("unused")
+        work = MoveWork(id="ucimv001", level=SkillLevel(3))
+        positions = [
+            WorkPosition(work=work, position_index=0, url=None, skip=False,
+                         root_fen=START, moves=[]),
+        ]
+        res = await engine.go_multiple(
+            chunk_of(work, EngineFlavor.MULTI_VARIANT, positions,
+                     variant="kingOfTheHill")
+        )
+        await engine.close()
+        return res
+
+    (r,) = asyncio.run(main())
+    assert r.best_move is not None
+
+
+def test_spawn_failure_is_engine_error():
+    async def main():
+        engine = UciEngine("/nonexistent/engine/binary")
+        work = MoveWork(id="ucimv002", level=SkillLevel(1))
+        positions = [
+            WorkPosition(work=work, position_index=0, url=None, skip=False,
+                         root_fen=START, moves=[]),
+        ]
+        await engine.go_multiple(
+            chunk_of(work, EngineFlavor.MULTI_VARIANT, positions)
+        )
+
+    with pytest.raises(EngineError):
+        asyncio.run(main())
