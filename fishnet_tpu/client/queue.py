@@ -154,7 +154,8 @@ class Queue:
                 continue  # discarded overlap position
             if res.position_index >= len(pending.positions):
                 continue
-            progress_at = ProgressAt(res.work.id, res.url, res.position_index)
+            # res.url already carries its #ply fragment (set by the planner)
+            progress_at = ProgressAt(res.work.id, res.url, None)
             pending.positions[res.position_index] = res
             if res.work.id not in batch_ids:
                 batch_ids.append(res.work.id)

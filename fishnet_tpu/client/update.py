@@ -1,0 +1,122 @@
+"""Auto-updater (reference: src/update.rs:13-200).
+
+Lists an S3-style release bucket (ListBucketResult XML), picks the highest
+semver for this target, streams the download, and atomically replaces the
+running entry point; the caller re-execs (reference: src/main.rs:399-425).
+For a Python deployment the replaceable artifact is a zipapp/pex-style
+single file; updates are skipped when running from a plain source tree.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import re
+import sys
+import tempfile
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from pathlib import Path
+from typing import List, Optional
+
+_S3_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+_VERSION_RE = re.compile(r"v?(\d+)\.(\d+)\.(\d+)")
+
+
+def current_target() -> str:
+    """Target triple analogue, e.g. linux-x86_64 (gnu→musl mapping of the
+    reference collapses here: a zipapp is platform-portable per-arch)."""
+    return f"{sys.platform}-{platform.machine()}"
+
+
+@dataclass(frozen=True)
+class Release:
+    version: tuple
+    key: str
+
+    @property
+    def version_str(self) -> str:
+        return ".".join(str(v) for v in self.version)
+
+
+def parse_bucket_listing(xml_text: str, target: str) -> List[Release]:
+    """Parse ListBucketResult XML → releases for this target
+    (reference: src/update.rs:63-89)."""
+    root = ET.fromstring(xml_text)
+    releases = []
+    for contents in root.iter(f"{_S3_NS}Contents"):
+        key_el = contents.find(f"{_S3_NS}Key")
+        if key_el is None or not key_el.text:
+            continue
+        key = key_el.text
+        if target not in key:
+            continue
+        m = _VERSION_RE.search(key)
+        if not m:
+            continue
+        releases.append(Release(tuple(int(g) for g in m.groups()), key))
+    return releases
+
+
+def latest_release(xml_text: str, target: Optional[str] = None) -> Optional[Release]:
+    releases = parse_bucket_listing(xml_text, target or current_target())
+    return max(releases, key=lambda r: r.version, default=None)
+
+
+def replaceable_artifact() -> Optional[Path]:
+    """The running single-file artifact, or None when running from a source
+    tree (in which case auto-update is a no-op, like the reference running
+    from cargo)."""
+    main = Path(sys.argv[0]).resolve()
+    if main.suffix in (".pyz", ".pex") and os.access(main, os.W_OK):
+        return main
+    return None
+
+
+def self_replace(artifact: Path, new_bytes: bytes) -> None:
+    """Atomic replacement of the running artifact
+    (reference: src/update.rs:59 via the self-replace crate)."""
+    fd, tmp = tempfile.mkstemp(dir=str(artifact.parent), prefix=".update-")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(new_bytes)
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, artifact)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def restart_process() -> None:
+    """Replace the process image with a fresh invocation
+    (reference: src/main.rs:399-425)."""
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+async def auto_update(http_get, bucket_url: str, logger) -> Optional[str]:
+    """Check the bucket and self-replace if a newer version exists.
+
+    http_get: async (url) -> bytes. Returns the new version string when an
+    update was applied (caller should restart_process after graceful drain).
+    """
+    from .. import __version__
+
+    artifact = replaceable_artifact()
+    if artifact is None:
+        logger.debug("Not running from a replaceable artifact; skipping update")
+        return None
+    xml_text = (await http_get(bucket_url)).decode("utf-8", "replace")
+    release = latest_release(xml_text)
+    if release is None:
+        logger.debug("No releases found for this target")
+        return None
+    current = tuple(int(x) for x in __version__.split(".")[:3])
+    if release.version <= current:
+        logger.debug(f"Up to date (latest {release.version_str})")
+        return None
+    logger.info(f"Updating to {release.version_str} ...")
+    blob = await http_get(bucket_url.rstrip("/") + "/" + release.key)
+    self_replace(artifact, blob)
+    return release.version_str

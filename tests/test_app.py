@@ -1,0 +1,61 @@
+"""Full-application smoke test: `python -m fishnet_tpu` as a subprocess
+against the fake lichess server, graceful SIGINT shutdown."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fake_server import FakeLichess
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def server():
+    s = FakeLichess().start()
+    yield s
+    s.stop()
+
+
+def test_app_end_to_end(server, tmp_path):
+    server.add_analysis_job("app00001", START, ["e2e4", "e7e5"], timeout_ms=5000)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "fishnet_tpu", "run",
+            "--no-conf", "--endpoint", server.url, "--key", "testkey",
+            "--backend", "python", "--cores", "1", "--no-stats-file",
+        ],
+        cwd=str(tmp_path),
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and "app00001" not in server.analyses:
+            time.sleep(0.1)
+            if proc.poll() is not None:
+                out = proc.stdout.read()
+                pytest.fail(f"client exited early ({proc.returncode}):\n{out}")
+        assert "app00001" in server.analyses, "no analysis submitted"
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGINT)
+            proc.wait(timeout=30)
+        out = proc.stdout.read()
+        assert "><> " in out  # headline present
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    final = server.analyses["app00001"][-1]
+    assert len(final["analysis"]) == 3

@@ -1,0 +1,118 @@
+"""Config system tests: durations, cores, backlog, ini merge, systemd units,
+update XML parsing, backoff."""
+import os
+
+import pytest
+
+from fishnet_tpu.client.backoff import RandomizedBackoff
+from fishnet_tpu.client.configure import (
+    Config,
+    build_parser,
+    merge,
+    parse_backlog,
+    parse_cores,
+    parse_duration,
+    read_ini,
+    validate_key,
+    write_ini,
+)
+from fishnet_tpu.client.systemd import exec_start, system_unit, user_unit
+from fishnet_tpu.client.update import latest_release, parse_bucket_listing
+
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30
+    assert parse_duration("2m") == 120
+    assert parse_duration("1h") == 3600
+    assert parse_duration("1d") == 86400
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("45") == 45
+    with pytest.raises(ValueError):
+        parse_duration("abc")
+
+
+def test_parse_cores():
+    n = os.cpu_count() or 1
+    assert parse_cores(None) == max(n - 1, 1)
+    assert parse_cores("auto") == max(n - 1, 1)
+    assert parse_cores("all") == n
+    assert parse_cores("1") == 1
+    with pytest.raises(ValueError):
+        parse_cores("0")
+
+
+def test_parse_backlog():
+    assert parse_backlog(None) is None
+    assert parse_backlog("short") == 30.0
+    assert parse_backlog("long") == 3600.0
+    assert parse_backlog("90s") == 90.0
+
+
+def test_validate_key():
+    assert validate_key("abcDEF123") == "abcDEF123"
+    with pytest.raises(ValueError):
+        validate_key("bad key!")
+
+
+def test_ini_roundtrip(tmp_path):
+    path = tmp_path / "fishnet.ini"
+    write_ini(path, {"key": "abc123", "cores": 4, "endpoint": "http://x/fishnet"})
+    ini = read_ini(path)
+    assert ini["key"] == "abc123"
+    assert ini["cores"] == "4"
+
+
+def test_ini_without_section_header(tmp_path):
+    path = tmp_path / "fishnet.ini"
+    path.write_text("key = abc123\ncores = 2\n")
+    ini = read_ini(path)
+    assert ini["key"] == "abc123"
+
+
+def test_merge_cli_over_ini():
+    args = build_parser().parse_args(["run", "--cores", "2", "--key", "clikey"])
+    ini = {"cores": "4", "key": "inikey", "endpoint": "http://ini/fishnet"}
+    cfg = merge(args, ini)
+    assert cfg.cores == min(2, os.cpu_count() or 1)  # CLI wins (clamped to host)
+    assert cfg.key == "clikey"
+    assert cfg.endpoint == "http://ini/fishnet"  # ini fills the gap
+
+
+def test_systemd_units():
+    cfg = Config(key="abc123", cores=4, user_backlog=30.0)
+    unit = system_unit(cfg)
+    assert "ExecStart=" in unit and "--key abc123" in unit
+    assert "ProtectSystem=strict" in unit
+    assert "Restart=on-failure" in unit
+    line = exec_start(cfg)
+    assert "--cores 4" in line and "--user-backlog 30s" in line
+    assert "WantedBy=default.target" in user_unit(cfg)
+
+
+S3_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">
+  <Name>fishnet-releases</Name>
+  <Contents><Key>v2.9.1/fishnet-tpu-linux-x86_64-v2.9.1.pyz</Key></Contents>
+  <Contents><Key>v2.9.3/fishnet-tpu-linux-x86_64-v2.9.3.pyz</Key></Contents>
+  <Contents><Key>v2.9.2/fishnet-tpu-darwin-arm64-v2.9.2.pyz</Key></Contents>
+</ListBucketResult>
+"""
+
+
+def test_update_bucket_parsing():
+    releases = parse_bucket_listing(S3_XML, "linux-x86_64")
+    assert len(releases) == 2
+    best = latest_release(S3_XML, "linux-x86_64")
+    assert best is not None and best.version == (2, 9, 3)
+    assert latest_release(S3_XML, "windows-amd64") is None
+
+
+def test_backoff_growth_and_cap():
+    b = RandomizedBackoff(max_s=5.0)
+    first = b.next()
+    assert 0.1 <= first <= 0.4
+    for _ in range(20):
+        delay = b.next()
+    assert delay <= 5.0
+    b.reset()
+    assert 0.1 <= b.next() <= 0.4
