@@ -44,7 +44,7 @@ def main() -> None:
     positions = [Position.from_fen(f) for f in fens]
     lanes = [from_position(positions[i % len(positions)]) for i in range(B)]
     roots = stack_boards(lanes)
-    params = nnue.init_params(jax.random.PRNGKey(0), l1=64)
+    params = nnue.init_params(jax.random.PRNGKey(0), l1=64, feature_set="board768")
 
     max_ply = DEPTH + 1
     depth = jnp.full((B,), DEPTH, jnp.int32)

@@ -1,0 +1,589 @@
+// chesscore: native host-side chess rules library.
+//
+// Plays the role shakmaty plays in the reference client (validating FEN +
+// replaying UCI moves for every acquired batch — reference:
+// src/queue.rs:554-581) as compiled code, with the same semantics as the
+// perft-validated Python library in fishnet_tpu/chess (X-FEN castling,
+// Chess960 king-takes-rook encoding). Exposed via a small C ABI consumed
+// with ctypes (fishnet_tpu/chess/native.py).
+//
+// Standard chess + Chess960. Variant games take the Python path.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+using u64 = uint64_t;
+
+constexpr int WHITE = 0, BLACK = 1;
+constexpr int PAWN = 0, KNIGHT = 1, BISHOP = 2, ROOK = 3, QUEEN = 4, KING = 5;
+
+constexpr u64 RANK_1 = 0xFFULL, RANK_2 = 0xFF00ULL, RANK_7 = 0xFF000000000000ULL,
+              RANK_8 = 0xFF00000000000000ULL;
+
+inline int lsb(u64 b) { return __builtin_ctzll(b); }
+inline int popcount(u64 b) { return __builtin_popcountll(b); }
+inline u64 bb(int sq) { return 1ULL << sq; }
+
+// ---- precomputed tables -------------------------------------------------
+
+u64 KNIGHT_ATT[64], KING_ATT[64], PAWN_ATT[2][64];
+u64 RAYS[8][64];  // E N NE NW W S SW SE
+u64 BETWEEN[64][64];
+
+constexpr int DIRS[8][2] = {{1, 0}, {0, 1}, {1, 1}, {-1, 1},
+                            {-1, 0}, {0, -1}, {-1, -1}, {1, -1}};
+
+struct TableInit {
+  TableInit() {
+    auto steps = [](int sq, const int (*deltas)[2], int n) {
+      u64 m = 0;
+      int f = sq & 7, r = sq >> 3;
+      for (int i = 0; i < n; i++) {
+        int nf = f + deltas[i][0], nr = r + deltas[i][1];
+        if (0 <= nf && nf < 8 && 0 <= nr && nr < 8) m |= bb(nr * 8 + nf);
+      }
+      return m;
+    };
+    constexpr int KN[8][2] = {{1, 2}, {2, 1}, {2, -1}, {1, -2},
+                              {-1, -2}, {-2, -1}, {-2, 1}, {-1, 2}};
+    constexpr int WP[2][2] = {{-1, 1}, {1, 1}};
+    constexpr int BP[2][2] = {{-1, -1}, {1, -1}};
+    for (int sq = 0; sq < 64; sq++) {
+      KNIGHT_ATT[sq] = steps(sq, KN, 8);
+      KING_ATT[sq] = steps(sq, DIRS, 8);
+      PAWN_ATT[WHITE][sq] = steps(sq, WP, 2);
+      PAWN_ATT[BLACK][sq] = steps(sq, BP, 2);
+      for (int d = 0; d < 8; d++) {
+        u64 m = 0;
+        int nf = (sq & 7) + DIRS[d][0], nr = (sq >> 3) + DIRS[d][1];
+        while (0 <= nf && nf < 8 && 0 <= nr && nr < 8) {
+          m |= bb(nr * 8 + nf);
+          nf += DIRS[d][0];
+          nr += DIRS[d][1];
+        }
+        RAYS[d][sq] = m;
+      }
+    }
+    for (int a = 0; a < 64; a++)
+      for (int d = 0; d < 8; d++) {
+        u64 ray = RAYS[d][a];
+        u64 m = ray;
+        while (m) {
+          int b_ = lsb(m);
+          m &= m - 1;
+          BETWEEN[a][b_] = ray & RAYS[(d + 4) % 8][b_];
+        }
+      }
+  }
+} table_init;
+
+inline u64 slider_att(int sq, u64 occ, int d0, int d1, int d2, int d3) {
+  u64 att = 0;
+  const int dirs[4] = {d0, d1, d2, d3};
+  for (int i = 0; i < 4; i++) {
+    int d = dirs[i];
+    u64 ray = RAYS[d][sq];
+    u64 blockers = ray & occ;
+    if (blockers) {
+      int first = d < 4 ? lsb(blockers) : 63 - __builtin_clzll(blockers);
+      ray &= ~RAYS[d][first];
+    }
+    att |= ray;
+  }
+  return att;
+}
+inline u64 rook_att(int sq, u64 occ) { return slider_att(sq, occ, 0, 1, 4, 5); }
+inline u64 bishop_att(int sq, u64 occ) { return slider_att(sq, occ, 2, 3, 6, 7); }
+
+// ---- position -----------------------------------------------------------
+
+struct Move {
+  int from, to, promo;  // promo: -1 none, else piece type; castling = K takes own R
+  bool operator==(const Move& o) const {
+    return from == o.from && to == o.to && promo == o.promo;
+  }
+};
+
+struct Pos {
+  u64 pieces[2][6] = {};
+  u64 occ[2] = {};
+  int turn = WHITE;
+  u64 castling = 0;  // rook squares retaining rights
+  int ep = -1;
+  int halfmove = 0, fullmove = 1;
+
+  u64 all() const { return occ[0] | occ[1]; }
+
+  void refresh() {
+    occ[0] = occ[1] = 0;
+    for (int t = 0; t < 6; t++) {
+      occ[0] |= pieces[0][t];
+      occ[1] |= pieces[1][t];
+    }
+  }
+
+  int piece_at(int sq, int color) const {
+    for (int t = 0; t < 6; t++)
+      if (pieces[color][t] & bb(sq)) return t;
+    return -1;
+  }
+
+  int king_sq(int color) const {
+    return pieces[color][KING] ? lsb(pieces[color][KING]) : -1;
+  }
+
+  u64 attackers(int color, int sq, u64 occAll) const {
+    u64 a = KNIGHT_ATT[sq] & pieces[color][KNIGHT];
+    a |= KING_ATT[sq] & pieces[color][KING];
+    a |= PAWN_ATT[color ^ 1][sq] & pieces[color][PAWN];
+    u64 rq = pieces[color][ROOK] | pieces[color][QUEEN];
+    if (rq) a |= rook_att(sq, occAll) & rq;
+    u64 bq = pieces[color][BISHOP] | pieces[color][QUEEN];
+    if (bq) a |= bishop_att(sq, occAll) & bq;
+    return a;
+  }
+
+  bool in_check(int color) const {
+    int k = king_sq(color);
+    return k >= 0 && attackers(color ^ 1, k, all());
+  }
+
+  void remove(int sq) {
+    for (int c = 0; c < 2; c++)
+      for (int t = 0; t < 6; t++) pieces[c][t] &= ~bb(sq);
+  }
+
+  void apply(const Move& m) {
+    int us = turn, them = turn ^ 1;
+    halfmove++;
+    int new_ep = -1;
+    int pt = piece_at(m.from, us);
+    bool is_castle = pt == KING && (pieces[us][ROOK] & bb(m.to));
+    if (is_castle) {
+      int rank = us == WHITE ? 0 : 56;
+      bool kingside = m.to > m.from;
+      remove(m.from);
+      remove(m.to);
+      pieces[us][KING] |= bb(rank + (kingside ? 6 : 2));
+      pieces[us][ROOK] |= bb(rank + (kingside ? 5 : 3));
+      castling &= ~(us == WHITE ? RANK_1 : RANK_8);
+    } else {
+      pieces[us][pt] &= ~bb(m.from);
+      int cap_sq = m.to;
+      if (pt == PAWN && m.to == ep && !(all() & bb(m.to)))
+        cap_sq = m.to + (us == WHITE ? -8 : 8);
+      if (occ[them] & bb(cap_sq)) {
+        remove(cap_sq);
+        halfmove = 0;
+        castling &= ~bb(cap_sq);
+      }
+      if (pt == PAWN) {
+        halfmove = 0;
+        if ((m.to - m.from) == 16 || (m.from - m.to) == 16)
+          new_ep = (m.from + m.to) / 2;
+      }
+      pieces[us][m.promo >= 0 ? m.promo : pt] |= bb(m.to);
+      if (pt == KING) castling &= ~(us == WHITE ? RANK_1 : RANK_8);
+      castling &= ~bb(m.from);
+    }
+    refresh();
+    ep = new_ep;
+    turn = them;
+    if (us == BLACK) fullmove++;
+  }
+
+  void pseudo_moves(std::vector<Move>& out) const {
+    int us = turn, them = turn ^ 1;
+    u64 own = occ[us], enemy = occ[them], occAll = all();
+    u64 promo_rank = us == WHITE ? RANK_8 : RANK_1;
+    int fwd = us == WHITE ? 8 : -8;
+    u64 start = us == WHITE ? RANK_2 : RANK_7;
+
+    auto push = [&](int f, int t) { out.push_back({f, t, -1}); };
+    auto push_maybe_promo = [&](int f, int t) {
+      if (bb(t) & promo_rank)
+        for (int p : {QUEEN, ROOK, BISHOP, KNIGHT}) out.push_back({f, t, p});
+      else
+        push(f, t);
+    };
+
+    u64 pawns = pieces[us][PAWN];
+    while (pawns) {
+      int f = lsb(pawns);
+      pawns &= pawns - 1;
+      int t1 = f + fwd;
+      if (!(occAll & bb(t1))) {
+        push_maybe_promo(f, t1);
+        if ((bb(f) & start) && !(occAll & bb(t1 + fwd))) push(f, t1 + fwd);
+      }
+      u64 caps = PAWN_ATT[us][f] & (enemy | (ep >= 0 ? bb(ep) : 0));
+      while (caps) {
+        int t = lsb(caps);
+        caps &= caps - 1;
+        push_maybe_promo(f, t);
+      }
+    }
+    auto gen = [&](int type, auto att_fn) {
+      u64 b = pieces[us][type];
+      while (b) {
+        int f = lsb(b);
+        b &= b - 1;
+        u64 targets = att_fn(f) & ~own;
+        while (targets) {
+          int t = lsb(targets);
+          targets &= targets - 1;
+          push(f, t);
+        }
+      }
+    };
+    gen(KNIGHT, [&](int f) { return KNIGHT_ATT[f]; });
+    gen(BISHOP, [&](int f) { return bishop_att(f, occAll); });
+    gen(ROOK, [&](int f) { return rook_att(f, occAll); });
+    gen(QUEEN, [&](int f) { return rook_att(f, occAll) | bishop_att(f, occAll); });
+    gen(KING, [&](int f) { return KING_ATT[f]; });
+
+    // castling: king takes own rook encoding; checks done here
+    int ksq = king_sq(us);
+    u64 back = us == WHITE ? RANK_1 : RANK_8;
+    if (ksq >= 0 && (bb(ksq) & back) && !in_check(us)) {
+      u64 rights = castling & back & pieces[us][ROOK];
+      while (rights) {
+        int rsq = lsb(rights);
+        rights &= rights - 1;
+        bool kingside = rsq > ksq;
+        int rank = us == WHITE ? 0 : 56;
+        int k_dest = rank + (kingside ? 6 : 2);
+        int r_dest = rank + (kingside ? 5 : 3);
+        u64 path = (BETWEEN[ksq][k_dest] | BETWEEN[rsq][r_dest] | bb(k_dest) |
+                    bb(r_dest)) &
+                   ~bb(ksq) & ~bb(rsq);
+        if (path & occAll) continue;
+        u64 occ2 = occAll & ~bb(ksq) & ~bb(rsq);
+        u64 kpath = BETWEEN[ksq][k_dest] | bb(k_dest);
+        bool safe = true;
+        u64 kp = kpath;
+        while (kp) {
+          int s = lsb(kp);
+          kp &= kp - 1;
+          if (attackers(them, s, occ2)) {
+            safe = false;
+            break;
+          }
+        }
+        if (safe) push(ksq, rsq);
+      }
+    }
+  }
+
+  bool is_castle_move(const Move& m) const {
+    return piece_at(m.from, turn) == KING && (pieces[turn][ROOK] & bb(m.to));
+  }
+
+  void legal_moves(std::vector<Move>& out) const {
+    std::vector<Move> pseudo;
+    pseudo_moves(pseudo);
+    out.clear();
+    for (const Move& m : pseudo) {
+      if (is_castle_move(m)) {
+        out.push_back(m);  // castling generator already verified safety
+        continue;
+      }
+      Pos child = *this;
+      child.apply(m);
+      if (!child.in_check(turn)) out.push_back(m);
+    }
+  }
+};
+
+// ---- FEN ----------------------------------------------------------------
+
+int parse_fen(const char* fen, Pos& pos) {
+  pos = Pos();
+  std::string s(fen);
+  std::vector<std::string> parts;
+  size_t i = 0;
+  while (i < s.size()) {
+    while (i < s.size() && s[i] == ' ') i++;
+    size_t j = i;
+    while (j < s.size() && s[j] != ' ') j++;
+    if (j > i) parts.push_back(s.substr(i, j - i));
+    i = j;
+  }
+  if (parts.empty()) return -1;
+  int rank = 7, file = 0;
+  for (char c : parts[0]) {
+    if (c == '/') {
+      if (file != 8) return -2;
+      rank--;
+      file = 0;
+    } else if (isdigit((unsigned char)c)) {
+      file += c - '0';
+    } else if (c == '~') {
+      continue;  // promoted marker (crazyhouse FENs); ignore
+    } else {
+      if (file > 7 || rank < 0) return -2;
+      int color = isupper((unsigned char)c) ? WHITE : BLACK;
+      int t;
+      switch (tolower((unsigned char)c)) {
+        case 'p': t = PAWN; break;
+        case 'n': t = KNIGHT; break;
+        case 'b': t = BISHOP; break;
+        case 'r': t = ROOK; break;
+        case 'q': t = QUEEN; break;
+        case 'k': t = KING; break;
+        default: return -3;
+      }
+      pos.pieces[color][t] |= bb(rank * 8 + file);
+      file++;
+    }
+  }
+  if (rank != 0 || file != 8) return -2;
+  pos.refresh();
+  pos.turn = (parts.size() > 1 && parts[1] == "b") ? BLACK : WHITE;
+  if (parts.size() > 2 && parts[2] != "-") {
+    for (char c : parts[2]) {
+      int color = isupper((unsigned char)c) ? WHITE : BLACK;
+      u64 back = color == WHITE ? RANK_1 : RANK_8;
+      int ksq = pos.king_sq(color);
+      u64 rooks = pos.pieces[color][ROOK] & back;
+      char lc = tolower((unsigned char)c);
+      if (lc == 'k' || lc == 'q') {
+        if (ksq < 0) continue;
+        int bestsq = -1;
+        u64 r = rooks;
+        while (r) {
+          int sq = lsb(r);
+          r &= r - 1;
+          if (lc == 'k' && sq > ksq && sq > bestsq) bestsq = sq;
+          if (lc == 'q' && sq < ksq && (bestsq < 0 || sq < bestsq)) bestsq = sq;
+        }
+        if (bestsq >= 0) pos.castling |= bb(bestsq);
+      } else if (lc >= 'a' && lc <= 'h') {
+        int sq = (color == WHITE ? 0 : 56) + (lc - 'a');
+        pos.castling |= bb(sq);
+      } else {
+        return -4;
+      }
+    }
+  }
+  if (parts.size() > 3 && parts[3] != "-" && parts[3].size() == 2) {
+    pos.ep = (parts[3][1] - '1') * 8 + (parts[3][0] - 'a');
+  }
+  size_t idx = 4;
+  if (parts.size() > idx && parts[idx].find('+') != std::string::npos) idx++;
+  if (parts.size() > idx) pos.halfmove = atoi(parts[idx].c_str());
+  if (parts.size() > idx + 1) pos.fullmove = atoi(parts[idx + 1].c_str());
+  if (popcount(pos.pieces[WHITE][KING]) != 1 ||
+      popcount(pos.pieces[BLACK][KING]) != 1)
+    return -5;
+  // side not to move must not be capturable
+  if (pos.in_check(pos.turn ^ 1)) return -6;
+  return 0;
+}
+
+std::string to_fen(const Pos& pos) {
+  std::string out;
+  for (int rank = 7; rank >= 0; rank--) {
+    int empty = 0;
+    for (int file = 0; file < 8; file++) {
+      int sq = rank * 8 + file;
+      char c = 0;
+      for (int col = 0; col < 2 && !c; col++) {
+        int t = pos.piece_at(sq, col);
+        if (t >= 0) {
+          c = "pnbrqk"[t];
+          if (col == WHITE) c = toupper(c);
+        }
+      }
+      if (!c) {
+        empty++;
+      } else {
+        if (empty) out += std::to_string(empty);
+        empty = 0;
+        out += c;
+      }
+    }
+    if (empty) out += std::to_string(empty);
+    if (rank) out += '/';
+  }
+  out += pos.turn == WHITE ? " w " : " b ";
+  std::string cast;
+  for (int color = 0; color < 2; color++) {
+    u64 back = color == WHITE ? RANK_1 : RANK_8;
+    int ksq = pos.king_sq(color);
+    u64 rooks = pos.pieces[color][ROOK] & back;
+    // emit in descending square order (kingside first)
+    for (int sq = 63; sq >= 0; sq--) {
+      if (!(pos.castling & back & bb(sq))) continue;
+      char c;
+      bool outermost = true;
+      u64 r = rooks;
+      while (r) {
+        int other = lsb(r);
+        r &= r - 1;
+        if (sq > ksq && other > sq) outermost = false;
+        if (sq < ksq && other < sq) outermost = false;
+      }
+      if (ksq >= 0 && outermost)
+        c = sq > ksq ? 'k' : 'q';
+      else
+        c = 'a' + (sq & 7);
+      cast += color == WHITE ? toupper(c) : c;
+    }
+  }
+  out += cast.empty() ? "-" : cast;
+  out += ' ';
+  if (pos.ep >= 0) {
+    out += ('a' + (pos.ep & 7));
+    out += ('1' + (pos.ep >> 3));
+  } else {
+    out += '-';
+  }
+  out += ' ' + std::to_string(pos.halfmove) + ' ' + std::to_string(pos.fullmove);
+  return out;
+}
+
+std::string move_uci(const Move& m) {
+  std::string s;
+  s += 'a' + (m.from & 7);
+  s += '1' + (m.from >> 3);
+  s += 'a' + (m.to & 7);
+  s += '1' + (m.to >> 3);
+  if (m.promo >= 0) s += "pnbrqk"[m.promo];
+  return s;
+}
+
+int parse_uci(const Pos& pos, const std::string& s, Move& out) {
+  if (s.size() < 4 || s.size() > 5) return -1;
+  auto sq = [](char f, char r) -> int {
+    if (f < 'a' || f > 'h' || r < '1' || r > '8') return -1;
+    return (r - '1') * 8 + (f - 'a');
+  };
+  int from = sq(s[0], s[1]), to = sq(s[2], s[3]);
+  if (from < 0 || to < 0) return -1;
+  int promo = -1;
+  if (s.size() == 5) {
+    switch (s[4]) {
+      case 'n': promo = KNIGHT; break;
+      case 'b': promo = BISHOP; break;
+      case 'r': promo = ROOK; break;
+      case 'q': promo = QUEEN; break;
+      default: return -1;
+    }
+  }
+  Move m{from, to, promo};
+  // normalize standard castling notation (e1g1) to king-takes-rook
+  if (pos.piece_at(from, pos.turn) == KING &&
+      !(pos.pieces[pos.turn][ROOK] & bb(to))) {
+    int df = (to & 7) - (from & 7);
+    if ((df == 2 || df == -2) && (to >> 3) == (from >> 3)) {
+      u64 back = pos.turn == WHITE ? RANK_1 : RANK_8;
+      u64 rights = pos.castling & back & pos.pieces[pos.turn][ROOK];
+      int best = -1;
+      u64 r = rights;
+      while (r) {
+        int rs = lsb(r);
+        r &= r - 1;
+        if (df > 0 && rs > from && rs > best) best = rs;
+        if (df < 0 && rs < from && (best < 0 || rs < best)) best = rs;
+      }
+      if (best >= 0) m = Move{from, best, -1};
+    }
+  }
+  std::vector<Move> legal;
+  pos.legal_moves(legal);
+  for (const Move& lm : legal)
+    if (lm == m) {
+      out = m;
+      return 0;
+    }
+  return -2;
+}
+
+long long perft_inner(const Pos& pos, int depth) {
+  std::vector<Move> moves;
+  pos.legal_moves(moves);
+  if (depth <= 1) return (long long)moves.size();
+  long long total = 0;
+  for (const Move& m : moves) {
+    Pos child = pos;
+    child.apply(m);
+    total += perft_inner(child, depth - 1);
+  }
+  return total;
+}
+
+int put_str(const std::string& s, char* out, int cap) {
+  if ((int)s.size() + 1 > cap) return -10;
+  memcpy(out, s.c_str(), s.size() + 1);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Replay a game: validate `fen` and every space-separated UCI move.
+// On success returns 0, writes the final FEN and the Chess960-normalized
+// moves. Returns 1+index for the first illegal move, negative for FEN errors.
+int cc_replay_game(const char* fen, const char* moves, char* out_fen,
+                   int out_fen_cap, char* out_moves, int out_moves_cap) {
+  Pos pos;
+  int err = parse_fen(fen, pos);
+  if (err) return err;
+  std::string norm;
+  std::string token;
+  const char* p = moves;
+  int index = 0;
+  while (true) {
+    if (*p == ' ' || *p == '\0') {
+      if (!token.empty()) {
+        Move m;
+        if (parse_uci(pos, token, m)) return 1 + index;
+        if (!norm.empty()) norm += ' ';
+        norm += move_uci(m);
+        pos.apply(m);
+        index++;
+        token.clear();
+      }
+      if (*p == '\0') break;
+    } else {
+      token += *p;
+    }
+    p++;
+  }
+  if (int rc = put_str(to_fen(pos), out_fen, out_fen_cap)) return rc;
+  if (int rc = put_str(norm, out_moves, out_moves_cap)) return rc;
+  return 0;
+}
+
+long long cc_perft(const char* fen, int depth) {
+  Pos pos;
+  if (parse_fen(fen, pos)) return -1;
+  if (depth <= 0) return 1;
+  return perft_inner(pos, depth);
+}
+
+int cc_legal_moves(const char* fen, char* out, int cap) {
+  Pos pos;
+  int err = parse_fen(fen, pos);
+  if (err) return err;
+  std::vector<Move> moves;
+  pos.legal_moves(moves);
+  std::string s;
+  for (const Move& m : moves) {
+    if (!s.empty()) s += ' ';
+    s += move_uci(m);
+  }
+  if (int rc = put_str(s, out, cap)) return rc;
+  return (int)moves.size();
+}
+
+int cc_version() { return 1; }
+
+}  // extern "C"

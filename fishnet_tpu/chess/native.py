@@ -1,0 +1,110 @@
+"""ctypes bindings for the native chesscore library.
+
+Builds fishnet_tpu/cc/chesscore.cpp on first use (g++ -O2 -shared); falls
+back gracefully (native() returns None) when no compiler is available, in
+which case callers use the pure-Python rules library. The planner uses this
+for its hot validate-and-replay path (the role shakmaty's compiled code
+plays in the reference, src/queue.rs:554-581).
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+_CC_DIR = Path(__file__).resolve().parent.parent / "cc"
+_SRC = _CC_DIR / "chesscore.cpp"
+_LIB = _CC_DIR / "libchesscore.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             str(_SRC), "-o", str(_LIB)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def native() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            return None
+        lib.cc_replay_game.restype = ctypes.c_int
+        lib.cc_replay_game.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.cc_perft.restype = ctypes.c_longlong
+        lib.cc_perft.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.cc_legal_moves.restype = ctypes.c_int
+        lib.cc_legal_moves.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativeError(ValueError):
+    pass
+
+
+def replay_game(fen: str, moves: List[str]) -> Optional[Tuple[str, List[str]]]:
+    """Validate and replay with the native core.
+
+    Returns (final_fen, chess960_normalized_moves), None when the native
+    library is unavailable, or raises NativeError for invalid input.
+    """
+    lib = native()
+    if lib is None:
+        return None
+    out_fen = ctypes.create_string_buffer(128)
+    out_moves = ctypes.create_string_buffer(16 + 6 * max(len(moves), 1))
+    rc = lib.cc_replay_game(
+        fen.encode(), " ".join(moves).encode(),
+        out_fen, len(out_fen), out_moves, len(out_moves),
+    )
+    if rc < 0:
+        raise NativeError(f"invalid fen ({rc}): {fen!r}")
+    if rc > 0:
+        raise NativeError(f"illegal uci move {moves[rc - 1]!r} at index {rc - 1}")
+    norm = out_moves.value.decode()
+    return out_fen.value.decode(), norm.split() if norm else []
+
+
+def perft(fen: str, depth: int) -> Optional[int]:
+    lib = native()
+    if lib is None:
+        return None
+    result = lib.cc_perft(fen.encode(), depth)
+    return None if result < 0 else int(result)
+
+
+def legal_moves(fen: str) -> Optional[List[str]]:
+    lib = native()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(8192)
+    rc = lib.cc_legal_moves(fen.encode(), buf, len(buf))
+    if rc < 0:
+        raise NativeError(f"invalid fen ({rc}): {fen!r}")
+    s = buf.value.decode()
+    return s.split() if s else []

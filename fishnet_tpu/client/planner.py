@@ -69,12 +69,26 @@ class IncomingBatch:
         url = body.batch_url(endpoint_url)
         now = time.monotonic() if now is None else now
 
+        is_standard_chess = body.variant in ("standard", "chess960", "fromPosition")
+
         try:
             root_pos = from_fen(body.position, body.variant)
         except (InvalidFenError, ValueError) as e:
             raise IncomingError(f"invalid position: {e}") from e
 
-        is_standard_chess = body.variant in ("standard", "chess960", "fromPosition")
+        # hot replay path: the native C++ core validates and re-encodes the
+        # move list for standard chess; variants and environments without a
+        # toolchain fall through to the pure-Python replay below
+        body_moves: Optional[List[str]] = None
+        if is_standard_chess:
+            from ..chess import native
+
+            try:
+                replayed = native.replay_game(body.position, body.moves)
+            except native.NativeError as e:
+                raise IncomingError(str(e)) from None
+            if replayed is not None:
+                _final_fen, body_moves = replayed
         if body.work.is_analysis and is_standard_chess:
             flavor = (
                 EngineFlavor.TPU
@@ -93,16 +107,17 @@ class IncomingBatch:
 
         root_fen = root_pos.to_fen()
 
-        # replay every move, re-encoding into Chess960-style UCI
-        body_moves: List[str] = []
-        pos = root_pos
-        for uci in body.moves:
-            try:
-                move = pos.parse_uci(uci)
-            except (IllegalMoveError, ValueError) as e:
-                raise IncomingError(f"illegal uci move: {e}") from e
-            body_moves.append(move.uci())
-            pos = pos.push(move)
+        if body_moves is None:
+            # replay every move, re-encoding into Chess960-style UCI
+            body_moves = []
+            pos = root_pos
+            for uci in body.moves:
+                try:
+                    move = pos.parse_uci(uci)
+                except (IllegalMoveError, ValueError) as e:
+                    raise IncomingError(f"illegal uci move: {e}") from e
+                body_moves.append(move.uci())
+                pos = pos.push(move)
 
         if isinstance(body.work, MoveWork):
             chunk = Chunk(

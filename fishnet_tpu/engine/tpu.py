@@ -73,7 +73,10 @@ class TpuEngine:
             if weights_path:
                 params = nnue.load_params(weights_path)
             else:
-                params = nnue.init_params(jax.random.PRNGKey(seed), l1=64)
+                # board768: fully-incremental accumulators (see models/nnue.py)
+                params = nnue.init_params(
+                    jax.random.PRNGKey(seed), l1=64, feature_set="board768"
+                )
         self.params = params
         self.max_depth = max_depth
 

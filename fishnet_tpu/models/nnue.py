@@ -30,6 +30,12 @@ NUM_KING_BUCKETS = 32
 NUM_PIECE_KINDS = 11  # our P N B R Q, their P N B R Q, kings (shared plane)
 NUM_SQUARES = 64
 NUM_FEATURES = NUM_KING_BUCKETS * NUM_PIECE_KINDS * NUM_SQUARES  # 22528
+# board768: the TPU fast-path feature set — 12 piece kinds × 64 squares per
+# perspective, no king buckets. King-bucketed sets force a full accumulator
+# refresh whenever a king moves; under lockstep masked execution that
+# refresh branch would run every step for every lane, so the fast path uses
+# a set whose updates are *always* incremental (≤4 changed features/move).
+NUM_FEATURES_768 = 12 * 64
 NUM_OUTPUT_BUCKETS = 8
 OUTPUT_SCALE = 600.0  # network output [-1,1]-ish → centipawns
 
@@ -58,11 +64,16 @@ class NnueParams(NamedTuple):
 
 
 def init_params(
-    key, l1: int = 256, h1: int = 16, h2: int = 32, dtype=jnp.float32
+    key, l1: int = 256, h1: int = 16, h2: int = 32, dtype=jnp.float32,
+    feature_set: str = "halfkav2_hm",
 ) -> NnueParams:
+    num_features = {
+        "halfkav2_hm": NUM_FEATURES,
+        "board768": NUM_FEATURES_768,
+    }[feature_set]
     k = jax.random.split(key, 4)
     return NnueParams(
-        ft_w=(jax.random.normal(k[0], (NUM_FEATURES, l1)) * 0.02).astype(dtype),
+        ft_w=(jax.random.normal(k[0], (num_features, l1)) * 0.02).astype(dtype),
         ft_b=jnp.full((l1,), 0.5, dtype),
         l1_w=(jax.random.normal(k[1], (NUM_OUTPUT_BUCKETS, 2 * l1, h1))
               * (1.0 / np.sqrt(2 * l1))).astype(dtype),
@@ -123,6 +134,59 @@ def accumulators(params: NnueParams, board64: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def feature_index_768(code: jnp.ndarray, sq: jnp.ndarray,
+                      perspective: jnp.ndarray) -> jnp.ndarray:
+    """board768 feature row for one piece; -1 when code==0 (empty)."""
+    pt = piece_type(code)
+    col = piece_color(code)
+    kind = jnp.where(col == perspective, pt, 6 + pt)
+    o_sq = sq ^ jnp.where(perspective == 1, 56, 0)
+    return jnp.where(code > 0, kind * 64 + o_sq, -1)
+
+
+def feature_indices_768(board64: jnp.ndarray, perspective: jnp.ndarray) -> jnp.ndarray:
+    sq = jnp.arange(64, dtype=jnp.int32)
+    return feature_index_768(board64, sq, perspective)
+
+
+def refresh_accumulator_768(params: NnueParams, board64: jnp.ndarray,
+                            perspective: jnp.ndarray) -> jnp.ndarray:
+    idx = feature_indices_768(board64, perspective)
+    rows = params.ft_w[jnp.clip(idx, 0)]
+    rows = jnp.where((idx >= 0)[:, None], rows, 0)
+    return params.ft_b + jnp.sum(rows, axis=0)
+
+
+def accumulators_768(params: NnueParams, board64: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack(
+        [
+            refresh_accumulator_768(params, board64, jnp.int32(0)),
+            refresh_accumulator_768(params, board64, jnp.int32(1)),
+        ]
+    )
+
+
+def apply_acc_updates_768(params: NnueParams, acc: jnp.ndarray,
+                          codes: jnp.ndarray, sqs: jnp.ndarray,
+                          signs: jnp.ndarray) -> jnp.ndarray:
+    """Incrementally update a (2, L1) accumulator pair.
+
+    codes/sqs/signs: (K,) piece changes (code 0 → no-op). Cost: 2K gathers
+    of an (L1,) row — this is the whole point of board768.
+    """
+    for persp in (0, 1):
+        idx = feature_index_768(codes, sqs, jnp.int32(persp))  # (K,)
+        rows = params.ft_w[jnp.clip(idx, 0)]  # (K, L1)
+        rows = jnp.where((idx >= 0)[:, None], rows, 0)
+        delta = jnp.sum(rows * signs[:, None].astype(rows.dtype), axis=0)
+        acc = acc.at[persp].add(delta)
+    return acc
+
+
+def is_board768(params: NnueParams) -> bool:
+    return params.ft_w.shape[0] == NUM_FEATURES_768
+
+
 # ------------------------------------------------------------------- forward
 
 
@@ -149,8 +213,12 @@ def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
 
 
 def evaluate(params: NnueParams, board64: jnp.ndarray, stm: jnp.ndarray) -> jnp.ndarray:
-    """Full evaluation of one lane (refresh + forward)."""
-    acc = accumulators(params, board64)
+    """Full evaluation of one lane (refresh + forward); dispatches on the
+    feature set statically (by table shape)."""
+    if is_board768(params):
+        acc = accumulators_768(params, board64)
+    else:
+        acc = accumulators(params, board64)
     return forward_from_acc(params, acc, stm, output_bucket(board64))
 
 
@@ -164,6 +232,25 @@ def evaluate_reference(params: NnueParams, board64: np.ndarray, stm: int) -> flo
     """Pure-numpy reference implementation for parity tests."""
     p = jax.tree_util.tree_map(np.asarray, params)
     accs = []
+    if p.ft_w.shape[0] == NUM_FEATURES_768:
+        for persp in (0, 1):
+            acc = p.ft_b.astype(np.float64).copy()
+            for sq in range(64):
+                code = int(board64[sq])
+                if code == 0:
+                    continue
+                pt = (code - 1) % 6
+                col = 0 if code <= 6 else 1
+                kind = pt if col == persp else 6 + pt
+                o_sq = sq ^ (56 if persp == 1 else 0)
+                acc += p.ft_w[kind * 64 + o_sq]
+            accs.append(acc)
+        own, opp = (accs[0], accs[1]) if stm == 0 else (accs[1], accs[0])
+        x = np.concatenate([np.clip(own, 0, 1), np.clip(opp, 0, 1)])
+        ob = min((int(np.sum(board64 > 0)) - 1) // 4, NUM_OUTPUT_BUCKETS - 1)
+        h = np.clip(x @ p.l1_w[ob] + p.l1_b[ob], 0, 1)
+        h = np.clip(h @ p.l2_w[ob] + p.l2_b[ob], 0, 1)
+        return float((h @ p.out_w[ob] + p.out_b[ob]) * OUTPUT_SCALE)
     for persp in (0, 1):
         king_code = 6 if persp == 0 else 12
         ksq = int(np.argmax(board64 == king_code))
@@ -199,7 +286,9 @@ def save_params(params: NnueParams, path: str | Path) -> None:
     path = Path(path)
     meta = {
         "format": "fishnet-tpu-nnue-v1",
-        "feature_set": "HalfKAv2_hm",
+        "feature_set": (
+            "board768" if params.ft_w.shape[0] == NUM_FEATURES_768 else "HalfKAv2_hm"
+        ),
         "l1": int(params.ft_w.shape[1]),
         "h1": int(params.l1_w.shape[2]),
         "h2": int(params.l2_w.shape[2]),

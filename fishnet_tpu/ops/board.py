@@ -195,6 +195,60 @@ def make_move(b: Board, move: jnp.ndarray) -> Board:
     )
 
 
+def move_piece_changes(b: Board, move: jnp.ndarray):
+    """The ≤4 piece placements/removals a move causes, as fixed slots
+    (codes (4,), squares (4,), signs (4,)); code 0 marks an unused slot.
+
+    Feeds the incremental NNUE accumulator update (board768 path): castling
+    touches 4 slots (king out/in, rook out/in), captures/promotions ≤3.
+    Slot layout: [mover out, capture out, mover in, rook in(castle)].
+    """
+    frm = move & 63
+    to = (move >> 6) & 63
+    promo = (move >> 12) & 7
+    board = b.board
+    piece = board[frm]
+    target = board[to]
+    us = b.stm
+
+    is_pawn = piece_type(piece) == 0
+    is_king = piece_type(piece) == 5
+    is_castle = is_king & (piece_color(target) == us) & (piece_type(target) == 3)
+    is_ep = is_pawn & (to == b.ep) & (target == 0) & ((to & 7) != (frm & 7))
+    ep_victim = jnp.where(us == 0, to - 8, to + 8)
+
+    # slot 0: mover leaves frm
+    c0, s0, g0 = piece, frm, jnp.int32(-1)
+    # slot 1: captured piece leaves (normal capture, ep victim, or the
+    # castling rook leaving its origin square)
+    cap_code = jnp.where(
+        is_castle, target,
+        jnp.where(is_ep, board[jnp.clip(ep_victim, 0, 63)], target),
+    )
+    cap_sq = jnp.where(is_ep, jnp.clip(ep_victim, 0, 63), to)
+    c1 = jnp.where(piece_color(cap_code) >= 0, cap_code, 0)
+    c1 = jnp.where(is_castle | is_ep | (piece_color(target) == 1 - us), c1, 0)
+    s1, g1 = cap_sq, jnp.int32(-1)
+    # slot 2: mover arrives (promoted piece, or king to its castle square)
+    rank_base = jnp.where(us == 0, 0, 56)
+    kingside = to > frm
+    k_dest = rank_base + jnp.where(kingside, 6, 2)
+    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
+    placed = jnp.where(promo > 0, promo_piece, piece)
+    c2 = placed
+    s2 = jnp.where(is_castle, k_dest, to)
+    g2 = jnp.int32(1)
+    # slot 3: castling rook arrives
+    r_dest = rank_base + jnp.where(kingside, 5, 3)
+    c3 = jnp.where(is_castle, jnp.where(us == 0, T.W_ROOK, T.B_ROOK), 0)
+    s3, g3 = r_dest, jnp.int32(1)
+
+    codes = jnp.stack([c0, c1, c2, c3])
+    sqs = jnp.stack([s0, s1, s2, s3])
+    signs = jnp.stack([g0, g1, g2, g3])
+    return codes, sqs, signs
+
+
 # batched versions
 v_make_move = jax.vmap(make_move, in_axes=(Board(0, 0, 0, 0, 0), 0))
 v_in_check = jax.vmap(in_check, in_axes=(Board(0, 0, 0, 0, 0),))

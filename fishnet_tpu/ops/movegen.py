@@ -210,13 +210,15 @@ def generate_moves(b: Board):
         )
         span = span & (sq_idx != ksq_c) & (sq_idx != rsq_c)
         empty_ok = ~jnp.any(span & occ)
-        # king path (origin..dest inclusive) must not be attacked; test with
-        # king and castling rook lifted off the board
+        # king path (origin..dest inclusive, ≤7 contiguous squares on the
+        # back rank) must not be attacked; test with king and castling rook
+        # lifted off the board
         clean = board.at[ksq_c].set(0).at[rsq_c].set(0)
-        kpath = (sq_idx >= lo_k) & (sq_idx <= hi_k)
+        path_sqs = lo_k + jnp.arange(7, dtype=jnp.int32)
+        path_ok = path_sqs <= hi_k
         attacked = jax.vmap(
-            lambda s, m: jnp.where(m, is_attacked(clean, s, them), False)
-        )(sq_idx, kpath)
+            lambda s, m: jnp.where(m, is_attacked(clean, jnp.clip(s, 0, 63), them), False)
+        )(path_sqs, path_ok)
         safe = ~jnp.any(attacked)
         return has & empty_ok & safe, sq_idx[0] * 0 + (ksq_c | (rsq_c << 6))
 

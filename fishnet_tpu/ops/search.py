@@ -29,7 +29,13 @@ import jax
 import jax.numpy as jnp
 
 from ..models import nnue
-from .board import Board, is_attacked, king_square, make_move
+from .board import (
+    Board,
+    is_attacked,
+    king_square,
+    make_move,
+    move_piece_changes,
+)
 from .movegen import MAX_MOVES, generate_moves
 
 INF = 32500
@@ -61,6 +67,7 @@ class SearchState(NamedTuple):
     incheck: jnp.ndarray  # (B, P) bool
     pv: jnp.ndarray  # (B, P, P) int32
     pv_len: jnp.ndarray  # (B, P)
+    acc: jnp.ndarray  # (B, P+1, 2, L1) f32 incremental NNUE accumulators
     ply: jnp.ndarray  # (B,)
     mode: jnp.ndarray  # (B,)
     ret: jnp.ndarray  # (B,) value returned by just-finished node
@@ -81,11 +88,20 @@ def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
     )
 
 
-def init_state(roots: Board, depth: jnp.ndarray, node_budget: jnp.ndarray,
-               max_ply: int) -> SearchState:
+def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
+               node_budget: jnp.ndarray, max_ply: int) -> SearchState:
     """roots: batched Board (B leading dim); depth/node_budget: (B,)."""
     B = roots.stm.shape[0]
     P = max_ply
+    l1 = params.ft_w.shape[1]
+    if nnue.is_board768(params):
+        root_acc = jax.vmap(nnue.accumulators_768, in_axes=(None, 0))(
+            params, roots.board
+        )
+    else:
+        root_acc = jnp.zeros((B, 2, l1), params.ft_w.dtype)
+    acc = jnp.zeros((B, P + 1, 2, l1), params.ft_w.dtype)
+    acc = acc.at[:, 0].set(root_acc)
 
     def z(*shape, dtype=jnp.int32, fill=0):
         return jnp.full((B, *shape), fill, dtype=dtype)
@@ -108,6 +124,7 @@ def init_state(roots: Board, depth: jnp.ndarray, node_budget: jnp.ndarray,
         best=z(P, fill=-INF), best_move=z(P, fill=-1),
         incheck=z(P, dtype=jnp.bool_),
         pv=z(P, P, fill=-1), pv_len=z(P),
+        acc=acc,
         ply=z(), mode=z(), ret=z(),
         nodes=z(),
         depth_limit=depth.astype(jnp.int32),
@@ -139,8 +156,18 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
         fifty = b.halfmove >= 100
         is_leaf = (depth_left <= 0) | fifty | over_budget
 
-        # leaf value: NNUE eval (or draw for 50-move)
-        leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
+        # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
+        # path the accumulator came down the stack incrementally and only
+        # the small layer stack runs here; the halfkav2_hm compat path pays
+        # a full refresh per step.
+        if nnue.is_board768(params):
+            leaf_val = jnp.int32(
+                nnue.forward_from_acc(
+                    params, s.acc[ply], us, nnue.output_bucket(b.board)
+                )
+            )
+        else:
+            leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
         leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
         leaf_val = jnp.where(fifty, DRAW, leaf_val)
 
@@ -246,6 +273,15 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
         child = make_move(parent_b, jnp.maximum(move, 0))
         nply = ply + 1
 
+        if nnue.is_board768(params):
+            codes, sqs, signs = move_piece_changes(parent_b, jnp.maximum(move, 0))
+            child_acc = nnue.apply_acc_updates_768(
+                params, s.acc[ply], codes, sqs, signs
+            )
+            new_acc = s.acc.at[nply].set(child_acc)
+        else:
+            new_acc = s.acc
+
         advanced = s._replace(
             midx=s.midx.at[ply].add(1),
             board=s.board.at[nply].set(child.board),
@@ -253,6 +289,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
             ep=s.ep.at[nply].set(child.ep),
             castling=s.castling.at[nply].set(child.castling),
             halfmove=s.halfmove.at[nply].set(child.halfmove),
+            acc=new_acc,
             ply=nply,
             mode=MODE_ENTER,
         )
@@ -284,7 +321,7 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    state = init_state(roots, depth, node_budget, max_ply)
+    state = init_state(params, roots, depth, node_budget, max_ply)
     step = make_search_step(params)
 
     def cond(carry):

@@ -1,0 +1,56 @@
+"""Native C++ chesscore vs the perft-validated Python library."""
+import pytest
+
+from fishnet_tpu.chess import Position, perft as py_perft
+from fishnet_tpu.chess.native import (
+    NativeError,
+    legal_moves,
+    native,
+    perft,
+    replay_game,
+)
+
+pytestmark = pytest.mark.skipif(native() is None, reason="no C++ toolchain")
+
+PERFT_CASES = [
+    ("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", 4, 197281),
+    ("r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1", 3, 97862),
+    ("8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1", 4, 43238),
+    ("r3k2r/Pppp1ppp/1b3nbN/nP6/BBP1P3/q4N2/Pp1P2PP/R2Q1RK1 w kq - 0 1", 3, 9467),
+    ("rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8", 3, 62379),
+    ("bqnb1rkr/pp3ppp/3ppn2/2p5/5P2/P2P4/NPP1P1PP/BQ1BNRKR w HFhf - 2 9", 3, 12189),
+]
+
+
+@pytest.mark.parametrize("fen,depth,expected", PERFT_CASES,
+                         ids=[f[:16] for f, _, _ in PERFT_CASES])
+def test_native_perft(fen, depth, expected):
+    assert perft(fen, depth) == expected
+
+
+def test_legal_moves_match_python():
+    for fen, _, _ in PERFT_CASES:
+        pos = Position.from_fen(fen)
+        py = sorted(m.uci() for m in pos.legal_moves())
+        cc = sorted(legal_moves(fen))
+        assert cc == py, fen
+
+
+def test_replay_game_normalizes_castling():
+    fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    moves = "e2e4 e7e5 g1f3 b8c6 f1c4 g8f6 e1g1".split()
+    final_fen, norm = replay_game(fen, moves)
+    assert norm[-1] == "e1h1"  # chess960-normalized
+    # matches the python library's replay
+    pos = Position.from_fen(fen)
+    for uci in moves:
+        pos = pos.push(pos.parse_uci(uci))
+    assert final_fen == pos.to_fen()
+
+
+def test_replay_rejects_illegal():
+    fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    with pytest.raises(NativeError):
+        replay_game(fen, ["e2e5"])
+    with pytest.raises(NativeError):
+        replay_game("not a fen", [])

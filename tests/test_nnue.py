@@ -1,0 +1,77 @@
+"""NNUE tests: device vs numpy-reference parity, save/load round-trip, and
+board768 incremental accumulator correctness along real game playouts."""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fishnet_tpu.chess import Position
+from fishnet_tpu.models import nnue
+from fishnet_tpu.ops.board import from_position, make_move, move_piece_changes
+
+
+@pytest.fixture(scope="module", params=["halfkav2_hm", "board768"])
+def params(request):
+    return nnue.init_params(
+        jax.random.PRNGKey(3), l1=32, h1=8, h2=8, feature_set=request.param
+    )
+
+
+FENS = [
+    "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+    "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 b - - 0 1",
+    "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
+]
+
+
+def test_device_matches_reference(params):
+    ev = jax.jit(nnue.evaluate)
+    for fen in FENS:
+        b = from_position(Position.from_fen(fen))
+        got = float(ev(params, b.board, b.stm))
+        want = nnue.evaluate_reference(params, np.asarray(b.board), int(b.stm))
+        assert abs(got - want) < 0.5, fen
+
+
+def test_save_load_roundtrip(tmp_path, params):
+    path = tmp_path / "net.npz"
+    nnue.save_params(params, path)
+    loaded = nnue.load_params(path)
+    b = from_position(Position.from_fen(FENS[1]))
+    a = float(nnue.evaluate(params, b.board, b.stm))
+    c = float(nnue.evaluate(loaded, b.board, b.stm))
+    assert abs(a - c) < 1e-3
+
+
+def test_incremental_accumulator_matches_refresh():
+    params = nnue.init_params(jax.random.PRNGKey(7), l1=32, feature_set="board768")
+    upd = jax.jit(
+        lambda b, acc, mv: nnue.apply_acc_updates_768(
+            params, acc, *move_piece_changes(b, mv)
+        )
+    )
+    refresh = jax.jit(lambda board: nnue.accumulators_768(params, board))
+    mk = jax.jit(make_move)
+
+    rng = random.Random(11)
+    for fen in [FENS[0], FENS[1]]:
+        pos = Position.from_fen(fen)
+        b = from_position(pos)
+        acc = refresh(b.board)
+        for _ in range(40):
+            legal = pos.legal_moves()
+            if not legal or pos.outcome() is not None:
+                break
+            move = rng.choice(legal)
+            from test_device_board import encode_host_move
+
+            enc = encode_host_move(move)
+            acc = upd(b, acc, jnp.int32(enc))
+            b = mk(b, jnp.int32(enc))
+            pos = pos.push(move)
+            fresh = refresh(b.board)
+            err = float(jnp.max(jnp.abs(acc - fresh)))
+            assert err < 1e-3, f"acc drift {err} after {move.uci()} in {pos.to_fen()}"

@@ -10,9 +10,11 @@ from fishnet_tpu.ops.search import MATE, search_batch_jit
 from fishnet_tpu.ops import tables as T
 
 
-@pytest.fixture(scope="module")
-def params():
-    return nnue.init_params(jax.random.PRNGKey(0), l1=32, h1=8, h2=8)
+@pytest.fixture(scope="module", params=["board768", "halfkav2_hm"])
+def params(request):
+    return nnue.init_params(
+        jax.random.PRNGKey(0), l1=32, h1=8, h2=8, feature_set=request.param
+    )
 
 
 def run(params, fens, depth, budget=100_000, max_ply=None):
