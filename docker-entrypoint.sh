@@ -1,0 +1,19 @@
+#!/bin/sh
+# Env-var → flag mapping (reference: docker-entrypoint.sh:1-16).
+set -e
+
+ARGS="run --no-conf"
+[ -n "$KEY" ] && ARGS="$ARGS --key $KEY"
+[ -n "$KEY_FILE" ] && ARGS="$ARGS --key-file $KEY_FILE"
+[ -n "$CORES" ] && ARGS="$ARGS --cores $CORES"
+[ -n "$ENDPOINT" ] && ARGS="$ARGS --endpoint $ENDPOINT"
+[ -n "$BACKEND" ] && ARGS="$ARGS --backend $BACKEND"
+[ -n "$TPU_WEIGHTS" ] && ARGS="$ARGS --tpu-weights $TPU_WEIGHTS"
+[ -n "$USER_BACKLOG" ] && ARGS="$ARGS --user-backlog $USER_BACKLOG"
+[ -n "$SYSTEM_BACKLOG" ] && ARGS="$ARGS --system-backlog $SYSTEM_BACKLOG"
+[ -n "$MAX_BACKOFF" ] && ARGS="$ARGS --max-backoff $MAX_BACKOFF"
+[ -n "$CPU_PRIORITY" ] && ARGS="$ARGS --cpu-priority $CPU_PRIORITY"
+[ -n "$STATS_FILE" ] && ARGS="$ARGS --stats-file $STATS_FILE"
+[ -n "$NO_STATS_FILE" ] && ARGS="$ARGS --no-stats-file"
+
+exec python -m fishnet_tpu $ARGS "$@"
