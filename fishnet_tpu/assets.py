@@ -1,0 +1,40 @@
+"""Asset management: NNUE weights shipped with the framework.
+
+The reference embeds two engine *binaries* plus their networks in a
+zstd-compressed archive, unpacked to a tempdir at startup after CPU feature
+detection (reference: src/assets.rs:15, 52-101, 186-227). In a TPU
+framework the executable is the XLA program compiled at runtime, so the
+asset that remains is the *weights*: packaged .npz files selected by
+feature set, resident in HBM once loaded. There is nothing to unpack and
+no SIMD dispatch — XLA compiles for whatever chip is attached.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+ASSET_DIR = Path(__file__).resolve().parent / "assets"
+
+DEFAULT_NETS = {
+    "board768": "nnue-board768-64.npz",
+    "halfkav2_hm": "nnue-hkav2-64.npz",
+}
+
+
+def default_weights_path(feature_set: str = "board768") -> Optional[Path]:
+    """Packaged weights for a feature set, or None if not shipped."""
+    name = DEFAULT_NETS.get(feature_set)
+    if name is None:
+        return None
+    path = ASSET_DIR / name
+    return path if path.exists() else None
+
+
+def load_default_params(feature_set: str = "board768"):
+    """Load packaged weights; falls back to None when absent."""
+    from .models import nnue
+
+    path = default_weights_path(feature_set)
+    if path is None:
+        return None
+    return nnue.load_params(path)

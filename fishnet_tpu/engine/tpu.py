@@ -73,7 +73,12 @@ class TpuEngine:
             if weights_path:
                 params = nnue.load_params(weights_path)
             else:
-                # board768: fully-incremental accumulators (see models/nnue.py)
+                # packaged weights (assets.py); board768 = the
+                # fully-incremental fast path (see models/nnue.py)
+                from ..assets import load_default_params
+
+                params = load_default_params("board768")
+            if params is None:
                 params = nnue.init_params(
                     jax.random.PRNGKey(seed), l1=64, feature_set="board768"
                 )

@@ -135,11 +135,12 @@ def train_material_net(
     seed: int = 0,
     dataset: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
     lr: float = 1e-3,
+    feature_set: str = "board768",
 ):
     """Train a small net against the material+mobility oracle. Returns
     (params, final_loss). Gives the TPU engine sane (if modest) play
     without external weights."""
-    params = nnue.init_params(jax.random.PRNGKey(seed), l1=l1)
+    params = nnue.init_params(jax.random.PRNGKey(seed), l1=l1, feature_set=feature_set)
     optimizer = optax.adam(lr)
     opt_state = optimizer.init(params)
     step = make_train_step(optimizer)

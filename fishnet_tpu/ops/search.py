@@ -134,172 +134,170 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
 
 
 def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
-    """One state-machine step for a single lane (vmapped over B)."""
-    ply = s.ply
+    """One state-machine step for a single lane (vmapped over B).
 
+    Every stack mutation is a masked *row-level* update (`at[ply].set` with
+    a where-selected row): tree-level conds/selects would force XLA to copy
+    whole (MAX_PLY, …) stacks per step, which dominates per-step cost.
+    """
     # ---------------------------------------------------------- phase ENTER
-    def phase_enter(s):
-        b = _board_at(s, ply)
-        us = b.stm
-        them = 1 - us
-        our_k = king_square(b.board, us)
-        their_k = king_square(b.board, them)
-        # parent's move was illegal iff the side that just moved (them)
-        # left its king attacked (or captured outright)
-        parent_illegal = (ply > 0) & (
-            (their_k < 0)
-            | is_attacked(b.board, jnp.maximum(their_k, 0), us)
+    ply = s.ply
+    enter = s.mode == MODE_ENTER
+
+    b = _board_at(s, ply)
+    us = b.stm
+    them = 1 - us
+    our_k = king_square(b.board, us)
+    their_k = king_square(b.board, them)
+    # parent's move was illegal iff the side that just moved (them)
+    # left its king attacked (or captured outright)
+    parent_illegal = (ply > 0) & (
+        (their_k < 0) | is_attacked(b.board, jnp.maximum(their_k, 0), us)
+    )
+    we_are_checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
+    depth_left = s.depth_limit - ply
+    over_budget = s.nodes >= s.node_budget
+    fifty = b.halfmove >= 100
+    is_leaf = (depth_left <= 0) | fifty | over_budget
+
+    # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
+    # path the accumulator came down the stack incrementally and only the
+    # small layer stack runs here; the halfkav2_hm compat path pays a full
+    # refresh per step.
+    if nnue.is_board768(params):
+        leaf_val = jnp.int32(
+            nnue.forward_from_acc(params, s.acc[ply], us, nnue.output_bucket(b.board))
         )
-        we_are_checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
-        depth_left = s.depth_limit - ply
-        over_budget = s.nodes >= s.node_budget
-        fifty = b.halfmove >= 100
-        is_leaf = (depth_left <= 0) | fifty | over_budget
+    else:
+        leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
+    leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
+    leaf_val = jnp.where(fifty, DRAW, leaf_val)
 
-        # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
-        # path the accumulator came down the stack incrementally and only
-        # the small layer stack runs here; the halfkav2_hm compat path pays
-        # a full refresh per step.
-        if nnue.is_board768(params):
-            leaf_val = jnp.int32(
-                nnue.forward_from_acc(
-                    params, s.acc[ply], us, nnue.output_bucket(b.board)
-                )
-            )
-        else:
-            leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
-        leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
-        leaf_val = jnp.where(fifty, DRAW, leaf_val)
+    gen_moves, gen_count = generate_moves(b)
 
-        gen_moves, gen_count = generate_moves(b)
+    to_return = parent_illegal | is_leaf
+    expand = enter & ~to_return
 
-        ret = jnp.where(parent_illegal, ILLEGAL, leaf_val)
-        to_return = parent_illegal | is_leaf
-        new_mode = jnp.where(to_return, MODE_RETURN, MODE_TRYMOVE)
+    def row_upd(arr, val, mask):
+        return arr.at[ply].set(jnp.where(mask, val, arr[ply]))
 
-        expand = ~to_return
-        upd = lambda arr, val: arr.at[ply].set(jnp.where(expand, val, arr[ply]))
-        return s._replace(
-            moves=s.moves.at[ply].set(
-                jnp.where(expand, gen_moves, s.moves[ply])
-            ),
-            count=upd(s.count, gen_count),
-            midx=upd(s.midx, 0),
-            searched=upd(s.searched, 0),
-            alpha=upd(s.alpha, jnp.where(ply == 0, -INF, -s.beta[ply - 1])),
-            beta=upd(s.beta, jnp.where(ply == 0, INF, -s.alpha[ply - 1])),
-            best=upd(s.best, -INF),
-            best_move=upd(s.best_move, -1),
-            incheck=s.incheck.at[ply].set(we_are_checked),
-            # leaf nodes must also zero pv_len: the fold at the parent reads
-            # pv_len[child_ply], which would otherwise be a stale slot
-            pv_len=s.pv_len.at[ply].set(0),
-            ret=jnp.where(to_return, ret, s.ret),
-            mode=new_mode,
-            nodes=s.nodes + jnp.where(parent_illegal, 0, 1),
-        )
-
-    s = jax.lax.cond(s.mode == MODE_ENTER, phase_enter, lambda s: s, s)
+    moves = s.moves.at[ply].set(jnp.where(expand, gen_moves, s.moves[ply]))
+    count = row_upd(s.count, gen_count, expand)
+    midx = row_upd(s.midx, 0, expand)
+    searched = row_upd(s.searched, 0, expand)
+    alpha = row_upd(
+        s.alpha, jnp.where(ply == 0, -INF, -s.beta[jnp.maximum(ply - 1, 0)]), expand
+    )
+    beta = row_upd(
+        s.beta, jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)]), expand
+    )
+    best = row_upd(s.best, -INF, expand)
+    best_move = row_upd(s.best_move, -1, expand)
+    incheck = row_upd(s.incheck, we_are_checked, enter)
+    # leaf nodes must also zero pv_len: the fold at the parent reads
+    # pv_len[child_ply], which would otherwise be a stale slot
+    pv_len = row_upd(s.pv_len, 0, enter)
+    ret = jnp.where(
+        enter & to_return, jnp.where(parent_illegal, ILLEGAL, leaf_val), s.ret
+    )
+    nodes = s.nodes + jnp.where(enter & ~parent_illegal, 1, 0)
+    mode = jnp.where(
+        enter, jnp.where(to_return, MODE_RETURN, MODE_TRYMOVE), s.mode
+    )
 
     # --------------------------------------------------------- phase RETURN
-    def phase_return(s):
-        # the node at `ply` finished with value s.ret (from its stm's view)
-        at_root = ply == 0
+    # the node at `ply` finished with value `ret` (from its stm's view)
+    ret_m = mode == MODE_RETURN
+    at_root = ply == 0
+    parent = jnp.maximum(ply - 1, 0)
+    was_illegal = ret == ILLEGAL
+    v = -ret
+    tried = moves[parent, jnp.maximum(midx[parent] - 1, 0)]
+    better = ret_m & (~at_root) & (~was_illegal) & (v > best[parent])
+    fold = ret_m & ~at_root
 
-        # root: record and park (ret, not best[0] — ret carries the
-        # mate/stalemate value when the root had no legal moves)
-        root_done = s._replace(
-            root_score=jnp.where(at_root, s.ret, s.root_score),
-            root_move=jnp.where(at_root, s.best_move[0], s.root_move),
-            mode=jnp.where(at_root, MODE_DONE, s.mode),
+    best = best.at[parent].set(jnp.where(better, v, best[parent]))
+    best_move = best_move.at[parent].set(jnp.where(better, tried, best_move[parent]))
+    alpha = alpha.at[parent].set(
+        jnp.where(fold, jnp.maximum(alpha[parent], best[parent]), alpha[parent])
+    )
+    searched = searched.at[parent].set(
+        searched[parent] + jnp.where(fold & ~was_illegal, 1, 0)
+    )
+    # pv[parent] = tried + pv[ply]
+    new_pv_row = jnp.concatenate([tried[None], s.pv[ply][:-1]])
+    pv = s.pv.at[parent].set(jnp.where(better, new_pv_row, s.pv[parent]))
+    pv_len = pv_len.at[parent].set(
+        jnp.where(
+            better,
+            jnp.minimum(pv_len[ply] + 1, s.pv.shape[-1]),
+            pv_len[parent],
         )
-
-        # interior: fold into parent at ply-1
-        parent = jnp.maximum(ply - 1, 0)
-        was_illegal = s.ret == ILLEGAL
-        v = -s.ret
-        tried = s.moves[parent, jnp.maximum(s.midx[parent] - 1, 0)]
-        better = (~was_illegal) & (v > s.best[parent])
-        new_best = jnp.where(better, v, s.best[parent])
-        new_best_move = jnp.where(better, tried, s.best_move[parent])
-        new_alpha = jnp.maximum(s.alpha[parent], new_best)
-        new_searched = s.searched[parent] + jnp.where(was_illegal, 0, 1)
-        # pv[parent] = tried + pv[ply]
-        child_pv = s.pv[ply]
-        new_pv_row = jnp.concatenate(
-            [tried[None], child_pv[:-1]]
-        )
-        new_pv_len = jnp.minimum(s.pv_len[ply] + 1, s.pv.shape[-1])
-
-        folded = s._replace(
-            best=s.best.at[parent].set(new_best),
-            best_move=s.best_move.at[parent].set(new_best_move),
-            alpha=s.alpha.at[parent].set(new_alpha),
-            searched=s.searched.at[parent].set(new_searched),
-            pv=jnp.where(
-                better,
-                s.pv.at[parent].set(new_pv_row),
-                s.pv,
-            ),
-            pv_len=jnp.where(
-                better, s.pv_len.at[parent].set(new_pv_len), s.pv_len
-            ),
-            ply=parent,
-            mode=MODE_TRYMOVE,
-        )
-        return jax.tree_util.tree_map(
-            lambda a, b: jnp.where(at_root, a, b), root_done, folded
-        )
-
-    s = jax.lax.cond(s.mode == MODE_RETURN, phase_return, lambda s: s, s)
-    ply = s.ply  # may have been decremented by RETURN
+    )
+    # root: record and park (ret, not best[0] — ret carries the
+    # mate/stalemate value when the root had no legal moves)
+    root_score = jnp.where(ret_m & at_root, ret, s.root_score)
+    root_move = jnp.where(ret_m & at_root, best_move[0], s.root_move)
+    ply = jnp.where(fold, parent, ply)
+    mode = jnp.where(
+        ret_m, jnp.where(at_root, MODE_DONE, MODE_TRYMOVE), mode
+    )
 
     # -------------------------------------------------------- phase TRYMOVE
-    def phase_trymove(s):
-        # note: the node budget is enforced in ENTER (children degrade to
-        # leaf evals), not here — finishing a node early with searched==0
-        # would return -INF garbage to the parent
-        exhausted = s.midx[ply] >= s.count[ply]
-        cutoff = s.alpha[ply] >= s.beta[ply]
-        finish = exhausted | cutoff
+    # note: the node budget is enforced in ENTER (children degrade to leaf
+    # evals), not here — finishing a node early with searched==0 would
+    # return -INF garbage to the parent
+    try_m = mode == MODE_TRYMOVE
+    exhausted = midx[ply] >= count[ply]
+    cutoff = alpha[ply] >= beta[ply]
+    finish = exhausted | cutoff
+    advance = try_m & ~finish
 
-        # finished node value: best, or mate/stalemate when no legal child
-        no_legal = s.searched[ply] == 0
-        mate_val = jnp.where(s.incheck[ply], -(MATE - ply), DRAW)
-        fin_val = jnp.where(no_legal & exhausted, mate_val, s.best[ply])
+    # finished node value: best, or mate/stalemate when no legal child
+    no_legal = searched[ply] == 0
+    mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
+    fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
 
-        move = s.moves[ply, jnp.minimum(s.midx[ply], MAX_MOVES - 1)]
-        parent_b = _board_at(s, ply)
-        child = make_move(parent_b, jnp.maximum(move, 0))
-        nply = ply + 1
+    move = moves[ply, jnp.minimum(midx[ply], MAX_MOVES - 1)]
+    parent_b = Board(
+        board=s.board[ply], stm=s.stm[ply], ep=s.ep[ply],
+        castling=s.castling[ply], halfmove=s.halfmove[ply],
+    )
+    child = make_move(parent_b, jnp.maximum(move, 0))
+    nply = jnp.minimum(ply + 1, s.board.shape[0] - 1)
 
-        if nnue.is_board768(params):
-            codes, sqs, signs = move_piece_changes(parent_b, jnp.maximum(move, 0))
-            child_acc = nnue.apply_acc_updates_768(
-                params, s.acc[ply], codes, sqs, signs
-            )
-            new_acc = s.acc.at[nply].set(child_acc)
-        else:
-            new_acc = s.acc
+    midx = midx.at[ply].add(jnp.where(advance, 1, 0))
+    board = s.board.at[nply].set(jnp.where(advance, child.board, s.board[nply]))
+    stm = s.stm.at[nply].set(jnp.where(advance, child.stm, s.stm[nply]))
+    ep = s.ep.at[nply].set(jnp.where(advance, child.ep, s.ep[nply]))
+    castling = s.castling.at[nply].set(
+        jnp.where(advance, child.castling, s.castling[nply])
+    )
+    halfmove = s.halfmove.at[nply].set(
+        jnp.where(advance, child.halfmove, s.halfmove[nply])
+    )
+    if nnue.is_board768(params):
+        codes, sqs, signs = move_piece_changes(parent_b, jnp.maximum(move, 0))
+        child_acc = nnue.apply_acc_updates_768(params, s.acc[ply], codes, sqs, signs)
+        acc = s.acc.at[nply].set(jnp.where(advance, child_acc, s.acc[nply]))
+    else:
+        acc = s.acc
 
-        advanced = s._replace(
-            midx=s.midx.at[ply].add(1),
-            board=s.board.at[nply].set(child.board),
-            stm=s.stm.at[nply].set(child.stm),
-            ep=s.ep.at[nply].set(child.ep),
-            castling=s.castling.at[nply].set(child.castling),
-            halfmove=s.halfmove.at[nply].set(child.halfmove),
-            acc=new_acc,
-            ply=nply,
-            mode=MODE_ENTER,
-        )
-        finished = s._replace(ret=fin_val, mode=MODE_RETURN)
-        return jax.tree_util.tree_map(
-            lambda a, b: jnp.where(finish, a, b), finished, advanced
-        )
+    ret = jnp.where(try_m & finish, fin_val, ret)
+    mode = jnp.where(
+        try_m, jnp.where(finish, MODE_RETURN, MODE_ENTER), mode
+    )
+    ply = jnp.where(advance, nply, ply)
 
-    s = jax.lax.cond(s.mode == MODE_TRYMOVE, phase_trymove, lambda s: s, s)
-    return s
+    return SearchState(
+        board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
+        moves=moves, count=count, midx=midx, searched=searched,
+        alpha=alpha, beta=beta, best=best, best_move=best_move,
+        incheck=incheck, pv=pv, pv_len=pv_len, acc=acc,
+        ply=ply, mode=mode, ret=ret, nodes=nodes,
+        depth_limit=s.depth_limit, node_budget=s.node_budget,
+        root_score=root_score, root_move=root_move,
+    )
 
 
 def make_search_step(params: nnue.NnueParams):
