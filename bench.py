@@ -7,6 +7,10 @@ batch. vs_baseline divides by the reference client's own per-core NPS
 scheduling prior (400 knps, reference: src/stats.rs:203-214) × host cores —
 the documented proxy for "Stockfish-AVX2 on the same host" since this image
 bundles no Stockfish binary to measure directly.
+
+The search dispatches in bounded segments (ops/search.py
+search_batch_resumable) so no single device program runs unboundedly; a
+transient device/tunnel error is retried, then the batch shrinks.
 """
 from __future__ import annotations
 
@@ -16,11 +20,7 @@ import sys
 import time
 
 
-def main() -> None:
-    B = int(os.environ.get("BENCH_LANES", "256"))
-    DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
-    BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
-
+def run_once(B: int, depth: int, budget: int):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -28,7 +28,7 @@ def main() -> None:
     from fishnet_tpu.chess import Position
     from fishnet_tpu.models import nnue
     from fishnet_tpu.ops.board import from_position, stack_boards
-    from fishnet_tpu.ops.search import search_batch_jit
+    from fishnet_tpu.ops.search import search_batch_resumable
 
     # a spread of real game positions (openings → endgames)
     fens = [
@@ -46,21 +46,44 @@ def main() -> None:
     roots = stack_boards(lanes)
     params = nnue.init_params(jax.random.PRNGKey(0), l1=64, feature_set="board768")
 
-    max_ply = DEPTH + 1
-    depth = jnp.full((B,), DEPTH, jnp.int32)
-    budget = jnp.full((B,), BUDGET, jnp.int32)
+    max_ply = depth + 1
+    depth_arr = jnp.full((B,), depth, jnp.int32)
+    budget_arr = jnp.full((B,), budget, jnp.int32)
 
     # warmup / compile
-    out = search_batch_jit(params, roots, depth, budget, max_ply=max_ply)
+    out = search_batch_resumable(params, roots, depth_arr, budget_arr, max_ply=max_ply)
     jax.block_until_ready(out["nodes"])
 
     t0 = time.perf_counter()
-    out = search_batch_jit(params, roots, depth, budget, max_ply=max_ply)
+    out = search_batch_resumable(params, roots, depth_arr, budget_arr, max_ply=max_ply)
     jax.block_until_ready(out["nodes"])
     dt = time.perf_counter() - t0
 
     total_nodes = int(np.asarray(out["nodes"]).sum())
-    nps = total_nodes / dt
+    return total_nodes / dt
+
+
+def main() -> None:
+    B = int(os.environ.get("BENCH_LANES", "256"))
+    DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
+    BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
+
+    nps = None
+    last_err = None
+    attempts = ((B, DEPTH), (B, DEPTH), (min(64, B), min(3, DEPTH)))
+    for attempt, (b, d) in enumerate(attempts):
+        try:
+            nps = run_once(b, d, BUDGET)
+            B, DEPTH = b, d
+            break
+        except Exception as e:  # device/tunnel flake: retry, then shrink
+            last_err = e
+            print(f"bench attempt {attempt} (B={b}, depth={d}) failed: {e}",
+                  file=sys.stderr)
+            if attempt + 1 < len(attempts):
+                time.sleep(10.0)
+    if nps is None:
+        raise SystemExit(f"bench failed after retries: {last_err}")
 
     cores = os.cpu_count() or 1
     baseline = 400_000 * cores  # reference NPS prior × host cores

@@ -94,6 +94,25 @@ async def run(cfg: Config) -> int:
     )
 
     factory = make_engine_factory(cfg, logger)
+    if cfg.backend == "tpu":
+        # pay the XLA compile cost now, before any chunk deadline ticks;
+        # a flaky device at startup is non-fatal (workers retry per chunk)
+        engine = factory(EngineFlavor.TPU)
+        logger.info("Warming up TPU engine (compiling search program) ...")
+        for attempt in range(3):
+            try:
+                await asyncio.to_thread(engine.warmup)
+                logger.info("TPU engine ready.")
+                break
+            except Exception as e:
+                logger.warn(f"TPU warmup attempt {attempt + 1} failed: {e}")
+                if attempt < 2:
+                    await asyncio.sleep(5.0)
+        else:
+            logger.warn(
+                "Proceeding with a cold TPU engine; first chunks may miss "
+                "their deadlines while XLA compiles."
+            )
     tasks = [
         asyncio.ensure_future(worker(i, queue, factory, logger))
         for i in range(cfg.cores)

@@ -25,11 +25,14 @@ from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
 from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
 from ..ops.board import from_position, stack_boards
-from ..ops.search import MATE, search_batch_jit
+from ..ops.search import MATE, search_batch_resumable
 from .base import EngineError
 
 MAX_PLY = 24  # static stack depth; supports search depths up to 23
-LANE_BUCKETS = (8, 16, 32, 64, 128, 256)
+# 16 covers every single-pv chunk (planner emits ≤10 positions per chunk,
+# incl. skip-overlap re-appends — client/planner.py); 64 covers multipv
+# root-move lanes. Fewer buckets = fewer cold XLA compiles to warm up.
+LANE_BUCKETS = (16, 64, 128, 256)
 
 
 def _decode_uci(m: int) -> str:
@@ -84,6 +87,23 @@ class TpuEngine:
                 )
         self.params = params
         self.max_depth = max_depth
+
+    def warmup(self, buckets=LANE_BUCKETS[:2]) -> None:
+        """Pre-compile the hot search program for the given lane buckets.
+
+        XLA caches one program per (lane bucket, MAX_PLY) shape; without
+        this, the first chunk pays 20-40 s of compile against its deadline
+        (move jobs have a 7 s deadline — they would always fail cold).
+        16 covers single-pv chunks; 64 covers multipv root-move lanes
+        (which pad to ≥64). The reference similarly does its engine prep
+        before workers start (Assets::prepare, src/main.rs:94)."""
+        for b in buckets:
+            roots = stack_boards([from_position(Position.initial())] * b)
+            out = search_batch_resumable(
+                self.params, roots, jnp.ones((b,), jnp.int32),
+                jnp.full((b,), 64, jnp.int32), max_ply=MAX_PLY,
+            )
+            jax.block_until_ready(out["nodes"])
 
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
         loop = asyncio.get_running_loop()
@@ -161,19 +181,21 @@ class TpuEngine:
             per_pos_budget = budget if budget is not None else 10_000_000
             remaining = np.full(B, per_pos_budget, dtype=np.int64)
 
+            deadline = chunk.deadline - 0.25  # leave slack to package results
             for depth in range(1, target_depth + 1):
                 depth_arr = np.zeros(B, np.int32)
                 depth_arr[: len(lanes)] = depth
                 budget_arr = np.clip(remaining, 0, 2**31 - 1).astype(np.int32)
-                out = search_batch_jit(
+                out = search_batch_resumable(
                     self.params, roots, jnp.asarray(depth_arr),
                     jnp.asarray(budget_arr), max_ply=MAX_PLY,
+                    deadline=deadline,
                 )
                 out = {k: np.asarray(v) for k, v in out.items()}
                 exhausted_all = True
                 for j, i in enumerate(lanes):
-                    if remaining[j] <= 0:
-                        continue
+                    if remaining[j] <= 0 or not bool(out["done"][j]):
+                        continue  # lane skipped, or stopped mid-depth on deadline
                     nodes_total[i] += int(out["nodes"][j])
                     remaining[j] -= int(out["nodes"][j])
                     sc = int(out["score"][j])
@@ -189,8 +211,14 @@ class TpuEngine:
                     best_moves[i] = _decode_uci(mv) if mv >= 0 else None
                     if remaining[j] > 0:
                         exhausted_all = False
-                if exhausted_all:
+                if exhausted_all or time.monotonic() >= deadline:
                     break
+
+        # deadline hit before even depth 1 finished: no usable result for
+        # some lane — fail the whole chunk so the server reassigns it
+        # (reference forgets failed batches, src/queue.rs:226-233)
+        if any(depth_reached[i] == 0 for i in lanes):
+            raise EngineError("chunk deadline expired before depth 1 completed")
 
         elapsed = max(time.monotonic() - started, 1e-6)
         per_pos_time = elapsed / max(len(positions), 1)
@@ -227,7 +255,9 @@ class TpuEngine:
                 continue
             legal = pos.legal_moves()
             children = [pos.push(m) for m in legal]
-            B = _pad_lanes(len(children))
+            # pad to ≥64 so warmup's precompiled bucket covers the common
+            # 20-40 legal-move case (>64 legal moves is rare; pays compile)
+            B = _pad_lanes(max(len(children), 64))
             boards = [from_position(c) for c in children]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
 
@@ -238,17 +268,21 @@ class TpuEngine:
             per_pos_budget = budget if budget is not None else 10_000_000
             remaining = per_pos_budget
 
+            deadline = chunk.deadline - 0.25
             for depth in range(1, target_depth + 1):
                 depth_arr = np.zeros(B, np.int32)
                 depth_arr[: len(children)] = depth - 1
                 share = max(remaining // max(len(children), 1), 1)
-                out = search_batch_jit(
+                out = search_batch_resumable(
                     self.params, roots,
                     jnp.asarray(depth_arr),
                     jnp.asarray(np.full(B, min(share, 2**31 - 1), np.int32)),
                     max_ply=MAX_PLY,
+                    deadline=deadline,
                 )
                 out = {k: np.asarray(v) for k, v in out.items()}
+                if not bool(out["done"][: len(children)].all()):
+                    break  # deadline hit mid-depth: keep previous depth's lines
                 step_nodes = int(out["nodes"][: len(children)].sum()) + len(children)
                 nodes_total += step_nodes
                 remaining -= step_nodes
@@ -267,9 +301,13 @@ class TpuEngine:
                     pvs.set(rank, depth, line)
                 depth_reached = depth
                 best_move = ranked[0][1]
-                if remaining <= 0:
+                if remaining <= 0 or time.monotonic() >= deadline:
                     break
 
+            if depth_reached == 0:
+                raise EngineError(
+                    "chunk deadline expired before depth 1 completed (multipv)"
+                )
             dt = max(time.monotonic() - t0, 1e-6)
             responses.append(
                 PositionResponse(

@@ -307,6 +307,84 @@ def make_search_step(params: nnue.NnueParams):
     return jax.vmap(lambda s: _step_lane(params, s), in_axes=(lane_axes,))
 
 
+# ------------------------------------------------- segmented (resumable) run
+#
+# A deep search can take hundreds of thousands of lockstep steps. Running
+# them as ONE device program is fragile (a multi-minute XLA program can
+# trip device/runtime watchdogs, and cannot be interrupted when the chunk
+# deadline passes — reference fishnet races `go_multiple` against the
+# deadline and kills the engine process, src/main.rs:307-338). The
+# TPU-native equivalent of that kill switch: run the while_loop in bounded
+# segments and let the HOST decide between segments whether to continue,
+# stop on deadline, or abandon. State lives on device throughout; the only
+# per-segment host traffic is one scalar (steps executed).
+
+
+def _run_segment(params: nnue.NnueParams, state: SearchState,
+                 segment_steps: int):
+    step = make_search_step(params)
+
+    def cond(carry):
+        s, i = carry
+        return (i < segment_steps) & jnp.any(s.mode != MODE_DONE)
+
+    def body(carry):
+        s, i = carry
+        return step(s), i + 1
+
+    state, n = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
+    return state, n
+
+
+_run_segment_jit = jax.jit(_run_segment, static_argnames=("segment_steps",))
+_init_state_jit = jax.jit(init_state, static_argnames=("max_ply",))
+
+
+def extract_results(state: SearchState, steps) -> dict:
+    return {
+        "score": state.root_score,
+        "move": state.root_move,
+        "pv": state.pv[:, 0],
+        "pv_len": state.pv_len[:, 0],
+        "nodes": state.nodes,
+        "done": state.mode == MODE_DONE,
+        "steps": steps,
+    }
+
+
+def search_batch_resumable(
+    params: nnue.NnueParams,
+    roots: Board,
+    depth,
+    node_budget,
+    max_ply: int,
+    segment_steps: int = 20_000,
+    max_steps: int = 4_000_000,
+    deadline: float | None = None,
+):
+    """Like `search_batch`, but dispatched in bounded segments.
+
+    deadline: absolute time.monotonic() stamp; between segments the host
+    stops early when passed. Lanes not DONE at stop report done=False and
+    their root_score/move must be ignored by the caller.
+    """
+    import time as _time
+
+    B = roots.stm.shape[0]
+    depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
+    node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
+    state = _init_state_jit(params, roots, depth, node_budget, max_ply)
+    total = 0
+    while total < max_steps:
+        state, n = _run_segment_jit(params, state, segment_steps)
+        total += int(n)  # sync point: segment finished on device
+        if int(n) < segment_steps:
+            break  # every lane parked in DONE
+        if deadline is not None and _time.monotonic() >= deadline:
+            break
+    return extract_results(state, jnp.int32(total))
+
+
 def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
                  max_ply: int, max_steps: int = 2_000_000):
     """Run fixed-depth alpha-beta on B root positions in lockstep.
@@ -320,25 +398,8 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
     state = init_state(params, roots, depth, node_budget, max_ply)
-    step = make_search_step(params)
-
-    def cond(carry):
-        s, i = carry
-        return (i < max_steps) & jnp.any(s.mode != MODE_DONE)
-
-    def body(carry):
-        s, i = carry
-        return step(s), i + 1
-
-    state, steps = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
-    return {
-        "score": state.root_score,
-        "move": state.root_move,
-        "pv": state.pv[:, 0],
-        "pv_len": state.pv_len[:, 0],
-        "nodes": state.nodes,
-        "steps": steps,
-    }
+    state, steps = _run_segment(params, state, max_steps)
+    return extract_results(state, steps)
 
 
 search_batch_jit = jax.jit(search_batch, static_argnames=("max_ply", "max_steps"))

@@ -120,3 +120,42 @@ def test_batch_independence(params):
     assert together["score"][1] == alone1["score"][0]
     assert together["move"][0] == alone0["move"][0]
     assert together["move"][1] == alone1["move"][0]
+
+
+def test_resumable_matches_oneshot(params):
+    # segmented dispatch (tiny segments → many host round-trips) must be
+    # bit-identical to the single while_loop program
+    from fishnet_tpu.ops.search import search_batch_resumable
+
+    fens = [
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    ]
+    roots = stack_boards([from_position(Position.from_fen(f)) for f in fens])
+    one = {k: np.asarray(v) for k, v in search_batch_jit(
+        params, roots, 3, 5_000, max_ply=4).items()}
+    seg = {k: np.asarray(v) for k, v in search_batch_resumable(
+        params, roots, 3, 5_000, max_ply=4, segment_steps=97).items()}
+    for k in ("score", "move", "nodes", "pv_len"):
+        assert (one[k] == seg[k]).all(), k
+    assert (one["pv"] == seg["pv"]).all()
+    assert seg["done"].all()
+
+
+def test_resumable_deadline_stops_early(params):
+    # an already-passed deadline stops after one segment; unfinished lanes
+    # report done=False so callers ignore their scores
+    import time
+
+    from fishnet_tpu.ops.search import search_batch_resumable
+
+    roots = stack_boards(
+        [from_position(Position.from_fen(
+            "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1"))]
+    )
+    out = search_batch_resumable(
+        params, roots, 4, 500_000, max_ply=5, segment_steps=50,
+        deadline=time.monotonic() - 1.0,
+    )
+    assert int(out["steps"]) <= 100  # stopped after the first segment
+    assert not bool(np.asarray(out["done"])[0])

@@ -1,0 +1,24 @@
+"""Import this FIRST to force JAX onto host CPU in ad-hoc scripts.
+
+The image registers a remote-TPU ("axon") PJRT plugin from sitecustomize;
+once registered, even JAX_PLATFORMS=cpu still initializes it on first use
+(and hangs when the tunnel is down/busy). Deregistering the factory before
+any jax operation cleanly forces CPU — same trick as tests/conftest.py.
+
+Usage:  python -c "import tools.force_cpu; ..."   (or set N_DEV env first)
+"""
+import os
+
+n = os.environ.get("FORCE_CPU_DEVICES", "8")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
