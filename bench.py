@@ -25,6 +25,10 @@ def run_once(B: int, depth: int, budget: int):
     import jax.numpy as jnp
     import numpy as np
 
+    from fishnet_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+
     from fishnet_tpu.chess import Position
     from fishnet_tpu.models import nnue
     from fishnet_tpu.ops.board import from_position, stack_boards

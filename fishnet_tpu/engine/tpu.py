@@ -72,6 +72,9 @@ class TpuEngine:
         max_depth: int = 6,
         seed: int = 1234,
     ) -> None:
+        from ..utils import enable_compile_cache
+
+        enable_compile_cache()  # restarts reuse compiled search programs
         if params is None:
             if weights_path:
                 params = nnue.load_params(weights_path)

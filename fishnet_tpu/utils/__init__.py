@@ -1,0 +1,4 @@
+"""Small shared utilities (reference analog: src/util.rs)."""
+from .compile_cache import enable_compile_cache
+
+__all__ = ["enable_compile_cache"]

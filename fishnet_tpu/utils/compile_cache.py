@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache.
+
+The reference spends build time on PGO so shipped engine binaries start
+fast (reference: build.rs:249-261). The TPU analog of that cost is XLA
+compilation: the search program takes 20-40 s to compile per lane-bucket
+shape. Persisting compiled executables to disk makes every restart after
+the first start warm — the same "pay once, run fast forever" trade.
+
+Disabled with FISHNET_TPU_NO_COMPILE_CACHE=1 (e.g. read-only filesystems).
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+_enabled_path: Optional[Path] = None
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
+    """Point JAX's persistent compilation cache at a writable directory.
+
+    Idempotent; returns the cache dir, or None when disabled/unavailable.
+    Must be called before the first compilation to benefit it."""
+    global _enabled_path
+    if os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
+        return None
+    if _enabled_path is not None:
+        return _enabled_path
+    try:
+        import jax
+
+        p = Path(
+            path
+            or os.environ.get("FISHNET_TPU_COMPILE_CACHE")
+            or Path.home() / ".cache" / "fishnet-tpu" / "xla"
+        )
+        p.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(p))
+        # default thresholds skip small programs; cache everything — even
+        # the small host-callback programs add up across restarts
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _enabled_path = p
+        return p
+    except Exception:
+        return None  # old jax / read-only home: run without the cache
