@@ -97,10 +97,10 @@ async def run(cfg: Config) -> int:
     if cfg.backend == "tpu":
         # pay the XLA compile cost now, before any chunk deadline ticks;
         # a flaky device at startup is non-fatal (workers retry per chunk)
-        engine = factory(EngineFlavor.TPU)
         logger.info("Warming up TPU engine (compiling search program) ...")
         for attempt in range(3):
             try:
+                engine = factory(EngineFlavor.TPU)
                 await asyncio.to_thread(engine.warmup)
                 logger.info("TPU engine ready.")
                 break

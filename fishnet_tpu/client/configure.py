@@ -119,7 +119,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="analysis backend (default tpu)")
     p.add_argument("--engine-path", help="external Stockfish binary (subprocess backend)")
     p.add_argument("--variant-engine-path", help="external Fairy-Stockfish binary")
-    p.add_argument("--tpu-weights", help="NNUE weights file (.npz)")
+    p.add_argument("--tpu-weights",
+                   help="NNUE weights: our .npz or a Stockfish .nnue file")
     p.add_argument("--tpu-depth", type=int, help="max search depth for the TPU engine")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")

@@ -76,7 +76,12 @@ class TpuEngine:
 
         enable_compile_cache()  # restarts reuse compiled search programs
         if params is None:
-            if weights_path:
+            if weights_path and str(weights_path).endswith(".nnue"):
+                # real Stockfish network file (models/nnue_import.py)
+                from ..models import nnue_import
+
+                params = nnue_import.load_nnue(weights_path).as_device()
+            elif weights_path:
                 params = nnue.load_params(weights_path)
             else:
                 # packaged weights (assets.py); board768 = the

@@ -183,8 +183,11 @@ def apply_acc_updates_768(params: NnueParams, acc: jnp.ndarray,
     return acc
 
 
-def is_board768(params: NnueParams) -> bool:
-    return params.ft_w.shape[0] == NUM_FEATURES_768
+def is_board768(params) -> bool:
+    return (
+        isinstance(params, NnueParams)
+        and params.ft_w.shape[0] == NUM_FEATURES_768
+    )
 
 
 # ------------------------------------------------------------------- forward
@@ -212,9 +215,14 @@ def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
     return out * OUTPUT_SCALE
 
 
-def evaluate(params: NnueParams, board64: jnp.ndarray, stm: jnp.ndarray) -> jnp.ndarray:
+def evaluate(params, board64: jnp.ndarray, stm: jnp.ndarray) -> jnp.ndarray:
     """Full evaluation of one lane (refresh + forward); dispatches on the
-    feature set statically (by table shape)."""
+    feature set statically (by table shape / params type). Accepts either
+    our NnueParams or an imported Stockfish net (models/nnue_import.py)."""
+    if not isinstance(params, NnueParams):
+        from . import nnue_import
+
+        return nnue_import.evaluate_sf(params, board64, stm)
     if is_board768(params):
         acc = accumulators_768(params, board64)
     else:

@@ -1,0 +1,416 @@
+"""Importer for Stockfish `.nnue` network files (HalfKAv2_hm).
+
+The reference embeds two Stockfish nets as opaque binaries and lets the
+C++ engine evaluate them (reference: build.rs:8-9 embeds
+nn-1c0000000000.nnue + nn-37f18f62d772.nnue; src/assets.rs:15 ships them
+inside the asset archive). Here the file format itself is parsed on the
+host and the network becomes device-resident arrays evaluated by XLA —
+the "ship weights, not binaries" design (SURVEY.md §7.2).
+
+Supported layout — the SFNNv5-era HalfKAv2_hm serialization as written by
+the public nnue-pytorch trainer and read by Stockfish 15/16:
+
+    uint32 version | uint32 net_hash | uint32 len | len×u8 description
+    FeatureTransformer:
+        uint32 ft_hash
+        int16 biases[L1]
+        int16 weights[22528 × L1]          (row-major, feature-major)
+        int32 psqt_weights[22528 × 8]      (8 PSQT output buckets)
+    Network (8 layer stacks, stored bucket-by-bucket):
+        uint32 hash
+        per bucket b in 0..8:
+            fc_0: int32 biases[16],  int8 weights[16 × L1]
+            fc_1: int32 biases[32],  int8 weights[32 × 30]
+            fc_2: int32 biases[1],   int8 weights[1 × 32]
+
+    * FT activation is pairwise "squared clipped ReLU": each perspective's
+      L1 accumulator is split in halves, clamp(x,0,QA) of the two halves
+      multiplied elementwise → L1/2 values per perspective, concatenated
+      (side to move first) → L1 inputs to fc_0.
+    * fc_0 has 16 rows; row 15 is the *skip connection* added directly to
+      the output (nnue-pytorch docs), rows 0..15 feed a clipped ReLU.
+      fc_1 consumes 30 inputs: 15 clipped + 15 squared-clipped values.
+    * Any int16/int8/int32 array section may instead be stored LEB128-
+      compressed: magic b"COMPRESSED_LEB128" + uint32 byte_count + stream.
+    * Quantization scales: FT 127 (QA), hidden weights 64 (QB),
+      output scale 16; dequantized here to float32.
+
+Anything that doesn't match this layout (different sizes, unknown
+section lengths) raises UnsupportedNnueFormat rather than misparsing.
+There are no real `.nnue` files in this build environment, so the parser
+is validated by synthetic round-trip against its own writer
+(tests/test_nnue_import.py); the layout constants above are the public
+ones and size checks are strict enough to fail loudly on mismatch.
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+from functools import partial
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from . import nnue
+
+LEB_MAGIC = b"COMPRESSED_LEB128"
+NUM_FEATURES = nnue.NUM_FEATURES  # 22528 (32 buckets × 11 kinds × 64 sq)
+NUM_PSQT_BUCKETS = 8
+NUM_STACKS = 8
+FC0_OUT = 16  # 15 hidden + 1 skip row
+FC1_IN = 30  # 15 clipped + 15 squared-clipped
+FC1_OUT = 32
+
+QA = 127.0  # feature-transformer scale (activations 0..127 ≡ 0..1)
+QB = 64.0  # hidden-layer weight scale
+OUTPUT_SCALE = 16.0  # FV_SCALE: quantized net output / 16 = centipawns
+NNUE2SCORE = 600.0  # float-model output ±1 ≡ ±600 cp (nnue-pytorch)
+# quantized storage scales (nnue-pytorch serializer):
+#   ft w,b              × QA
+#   fc0/fc1 w           × QB          fc0/fc1 b × QA·QB
+#   fc2 w               × NNUE2SCORE·OUTPUT_SCALE/QA
+#   fc2 b, psqt w       × NNUE2SCORE·OUTPUT_SCALE
+
+
+class UnsupportedNnueFormat(ValueError):
+    pass
+
+
+_ARRAY_FIELDS = (
+    "ft_w", "ft_b", "psqt_w",
+    "fc0_w", "fc0_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b",
+)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=list(_ARRAY_FIELDS),
+    meta_fields=["version", "net_hash", "description"],
+)
+@dataclasses.dataclass(frozen=True)
+class StockfishNet:
+    """Dequantized HalfKAv2_hm net; array fields are float32.
+
+    A pytree whose metadata is static, so a net passes straight through
+    jit (e.g. as the `params` of ops.search.search_batch_jit)."""
+
+    ft_w: np.ndarray  # (NUM_FEATURES, L1)
+    ft_b: np.ndarray  # (L1,)
+    psqt_w: np.ndarray  # (NUM_FEATURES, 8) pawn-value units
+    fc0_w: np.ndarray  # (8, 16, L1)
+    fc0_b: np.ndarray  # (8, 16)
+    fc1_w: np.ndarray  # (8, 32, 30)
+    fc1_b: np.ndarray  # (8, 32)
+    fc2_w: np.ndarray  # (8, 1, 32)
+    fc2_b: np.ndarray  # (8, 1)
+    version: int = 0
+    net_hash: int = 0
+    description: bytes = b""
+
+    @property
+    def l1(self) -> int:
+        return self.ft_w.shape[1]
+
+    def as_device(self) -> "StockfishNet":
+        import jax.numpy as jnp
+
+        return dataclasses.replace(
+            self, **{f: jnp.asarray(getattr(self, f)) for f in _ARRAY_FIELDS}
+        )
+
+
+# ------------------------------------------------------------------ LEB128
+
+
+def _leb128_decode(buf: memoryview, count: int) -> tuple[np.ndarray, int]:
+    """Decode `count` signed LEB128 integers; returns (values, bytes_used)."""
+    out = np.empty(count, dtype=np.int64)
+    pos = 0
+    end = len(buf)
+    for i in range(count):
+        result = 0
+        shift = 0
+        while True:
+            if pos >= end:
+                raise UnsupportedNnueFormat("truncated LEB128 stream")
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                if b & 0x40:  # sign-extend
+                    result |= -(1 << shift)
+                break
+        out[i] = result
+    return out, pos
+
+
+def _leb128_encode(values: np.ndarray) -> bytes:
+    out = bytearray()
+    for v in map(int, values):
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if (v == 0 and not b & 0x40) or (v == -1 and b & 0x40):
+                out.append(b)
+                break
+            out.append(b | 0x80)
+    return bytes(out)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = memoryview(data)
+        self.pos = 0
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self.data, self.pos)
+        self.pos += 4
+        return v
+
+    def bytes(self, n: int) -> bytes:
+        b = bytes(self.data[self.pos : self.pos + n])
+        if len(b) != n:
+            raise UnsupportedNnueFormat("truncated file")
+        self.pos += n
+        return b
+
+    def array(self, dtype, count: int) -> np.ndarray:
+        """Read `count` values, either raw little-endian or LEB128-block."""
+        magic_len = len(LEB_MAGIC)
+        if bytes(self.data[self.pos : self.pos + magic_len]) == LEB_MAGIC:
+            self.pos += magic_len
+            nbytes = self.u32()
+            values, used = _leb128_decode(self.data[self.pos :], count)
+            if used != nbytes:
+                raise UnsupportedNnueFormat(
+                    f"LEB128 block length mismatch: header {nbytes}, used {used}"
+                )
+            self.pos += used
+            info = np.iinfo(dtype)
+            if values.min() < info.min or values.max() > info.max:
+                raise UnsupportedNnueFormat("LEB128 value out of dtype range")
+            return values.astype(dtype)
+        itemsize = np.dtype(dtype).itemsize
+        raw = self.bytes(count * itemsize)
+        return np.frombuffer(raw, dtype=np.dtype(dtype).newbyteorder("<")).astype(dtype)
+
+    def eof(self) -> bool:
+        return self.pos == len(self.data)
+
+
+# ------------------------------------------------------------------- parse
+
+
+def _infer_l1(total: int, header_end: int) -> int:
+    """Solve file size for L1 given the fixed layout (raw, uncompressed)."""
+    # size = ft_hash(4) + 2*L1 + 2*NF*L1 + 4*NF*8 + net_hash(4)
+    #        + 8 * (4*16 + 16*L1 + 4*32 + 32*30 + 4 + 32)
+    body = total - header_end
+    for l1 in (64, 128, 256, 512, 1024, 1536, 2048, 2560, 3072):
+        ft = 4 + 2 * l1 + 2 * NUM_FEATURES * l1 + 4 * NUM_FEATURES * NUM_PSQT_BUCKETS
+        stacks = 4 + NUM_STACKS * (
+            4 * FC0_OUT + FC0_OUT * l1 + 4 * FC1_OUT + FC1_OUT * FC1_IN + 4 + FC1_OUT
+        )
+        if ft + stacks == body:
+            return l1
+    raise UnsupportedNnueFormat(
+        f"cannot infer L1 from file size {total} (compressed files carry "
+        "explicit block lengths; raw files must match a known L1)"
+    )
+
+
+def load_nnue(path: str | Path, l1: int | None = None) -> StockfishNet:
+    """Parse a `.nnue` file into dequantized float32 arrays."""
+    data = Path(path).read_bytes()
+    r = _Reader(data)
+    version = r.u32()
+    net_hash = r.u32()
+    desc_len = r.u32()
+    if desc_len > 4096:
+        raise UnsupportedNnueFormat(f"implausible description length {desc_len}")
+    description = r.bytes(desc_len)
+
+    ft_hash = r.u32()  # noqa: F841 — validated only by downstream size checks
+    if l1 is None:
+        try:
+            l1 = _infer_l1(len(data), r.pos - 4)
+        except UnsupportedNnueFormat:
+            if LEB_MAGIC in data:  # compressed sections shrink the file
+                raise UnsupportedNnueFormat(
+                    "pass l1= explicitly for compressed files"
+                ) from None
+            raise
+    if l1 % 2:
+        raise UnsupportedNnueFormat("L1 must be even (pairwise activation)")
+
+    ft_b = r.array(np.int16, l1)
+    ft_w = r.array(np.int16, NUM_FEATURES * l1).reshape(NUM_FEATURES, l1)
+    psqt = r.array(np.int32, NUM_FEATURES * NUM_PSQT_BUCKETS).reshape(
+        NUM_FEATURES, NUM_PSQT_BUCKETS
+    )
+
+    _net_hash2 = r.u32()
+    fc0_w = np.empty((NUM_STACKS, FC0_OUT, l1), np.float32)
+    fc0_b = np.empty((NUM_STACKS, FC0_OUT), np.float32)
+    fc1_w = np.empty((NUM_STACKS, FC1_OUT, FC1_IN), np.float32)
+    fc1_b = np.empty((NUM_STACKS, FC1_OUT), np.float32)
+    fc2_w = np.empty((NUM_STACKS, 1, FC1_OUT), np.float32)
+    fc2_b = np.empty((NUM_STACKS, 1), np.float32)
+    for b in range(NUM_STACKS):
+        fc0_b[b] = r.array(np.int32, FC0_OUT) / (QA * QB)
+        fc0_w[b] = r.array(np.int8, FC0_OUT * l1).reshape(FC0_OUT, l1) / QB
+        fc1_b[b] = r.array(np.int32, FC1_OUT) / (QA * QB)
+        fc1_w[b] = r.array(np.int8, FC1_OUT * FC1_IN).reshape(FC1_OUT, FC1_IN) / QB
+        fc2_b[b] = r.array(np.int32, 1) / (NNUE2SCORE * OUTPUT_SCALE)
+        fc2_w[b] = r.array(np.int8, FC1_OUT).reshape(1, FC1_OUT) / (
+            NNUE2SCORE * OUTPUT_SCALE / QA
+        )
+    if not r.eof():
+        raise UnsupportedNnueFormat(
+            f"{len(data) - r.pos} trailing bytes after last layer stack"
+        )
+
+    return StockfishNet(
+        ft_w=(ft_w / QA).astype(np.float32),
+        ft_b=(ft_b / QA).astype(np.float32),
+        psqt_w=(psqt / (NNUE2SCORE * OUTPUT_SCALE)).astype(np.float32),
+        fc0_w=fc0_w, fc0_b=fc0_b, fc1_w=fc1_w, fc1_b=fc1_b,
+        fc2_w=fc2_w, fc2_b=fc2_b,
+        version=version, net_hash=net_hash, description=description,
+    )
+
+
+# ------------------------------------------------------------------ forward
+
+
+def evaluate_sf(net: StockfishNet, board64, stm):
+    """Centipawn-ish score for one position, SFNNv5 semantics, in jax.
+
+    Full-refresh evaluation (the engine's HalfKAv2_hm compat path; the
+    board768 fast path keeps its incremental accumulators instead)."""
+    import jax.numpy as jnp
+
+    l1 = net.ft_w.shape[1]
+    half = l1 // 2
+
+    from ..ops.board import king_square
+
+    def persp_acc(perspective):
+        ksq = king_square(board64, perspective)
+        idx = nnue.feature_indices(board64, perspective, jnp.maximum(ksq, 0))
+        rows = jnp.asarray(net.ft_w)[jnp.clip(idx, 0)]
+        rows = jnp.where((idx >= 0)[:, None], rows, 0)
+        psqt_rows = jnp.asarray(net.psqt_w)[jnp.clip(idx, 0)]
+        psqt_rows = jnp.where((idx >= 0)[:, None], psqt_rows, 0)
+        return jnp.asarray(net.ft_b) + rows.sum(0), psqt_rows.sum(0)
+
+    acc_w, psqt_w_ = persp_acc(jnp.int32(0))
+    acc_b, psqt_b_ = persp_acc(jnp.int32(1))
+    acc_own = jnp.where(stm == 0, acc_w, acc_b)
+    acc_opp = jnp.where(stm == 0, acc_b, acc_w)
+
+    def pairwise(acc):
+        c = jnp.clip(acc, 0.0, 1.0)
+        return c[:half] * c[half:]
+
+    x = jnp.concatenate([pairwise(acc_own), pairwise(acc_opp)])  # (L1,)
+
+    bucket = nnue.output_bucket(board64)
+    h0 = jnp.asarray(net.fc0_w)[bucket] @ x + jnp.asarray(net.fc0_b)[bucket]
+    skip = h0[15]
+    h = jnp.clip(h0[:15], 0.0, 1.0)
+    h1_in = jnp.concatenate([h, jnp.square(h)])  # (30,)
+    h1 = jnp.clip(
+        jnp.asarray(net.fc1_w)[bucket] @ h1_in + jnp.asarray(net.fc1_b)[bucket],
+        0.0, 1.0,
+    )
+    out = (jnp.asarray(net.fc2_w)[bucket] @ h1)[0] + jnp.asarray(net.fc2_b)[bucket][0]
+
+    psqt = jnp.where(stm == 0, psqt_w_ - psqt_b_, psqt_b_ - psqt_w_)[bucket] / 2.0
+    return (out + skip + psqt) * NNUE2SCORE
+
+
+def evaluate_sf_reference(net: StockfishNet, board64: np.ndarray, stm: int) -> float:
+    """Pure-numpy mirror of evaluate_sf for parity tests."""
+    l1 = net.ft_w.shape[1]
+    half = l1 // 2
+    accs, psqts = [], []
+    for persp in (0, 1):
+        king_code = 6 if persp == 0 else 12
+        ksq = int(np.argmax(board64 == king_code))
+        flip = 56 if persp == 1 else 0
+        o_ksq = ksq ^ flip
+        mirror = 7 if (o_ksq & 7) > 3 else 0
+        o_ksq ^= mirror
+        bucket = nnue.KING_BUCKET[o_ksq]
+        acc = net.ft_b.astype(np.float64).copy()
+        ps = np.zeros(NUM_PSQT_BUCKETS)
+        for sq in range(64):
+            code = int(board64[sq])
+            if code == 0:
+                continue
+            pt = (code - 1) % 6
+            col = 0 if code <= 6 else 1
+            kind = 10 if pt == 5 else (pt if col == persp else 5 + pt)
+            o_sq = (sq ^ flip) ^ mirror
+            idx = bucket * (11 * 64) + kind * 64 + o_sq
+            acc += net.ft_w[idx]
+            ps += net.psqt_w[idx]
+        accs.append(acc)
+        psqts.append(ps)
+    own, opp = (0, 1) if stm == 0 else (1, 0)
+
+    def pairwise(a):
+        c = np.clip(a, 0.0, 1.0)
+        return c[:half] * c[half:]
+
+    x = np.concatenate([pairwise(accs[own]), pairwise(accs[opp])])
+    ob = min((int(np.sum(board64 > 0)) - 1) // 4, NUM_PSQT_BUCKETS - 1)
+    h0 = net.fc0_w[ob] @ x + net.fc0_b[ob]
+    skip = h0[15]
+    h = np.clip(h0[:15], 0.0, 1.0)
+    h1 = np.clip(net.fc1_w[ob] @ np.concatenate([h, h * h]) + net.fc1_b[ob], 0.0, 1.0)
+    out = float((net.fc2_w[ob] @ h1 + net.fc2_b[ob])[0])
+    psqt = (psqts[own][ob] - psqts[opp][ob]) / 2.0
+    return (out + skip + psqt) * NNUE2SCORE
+
+
+# ---------------------------------------------------- synthetic writer (tests)
+
+
+def write_nnue(path: str | Path, net_q: dict, compress_ft: bool = False) -> None:
+    """Serialize quantized arrays into the `.nnue` layout (test fixture).
+
+    net_q keys: ft_b int16[L1], ft_w int16[NF,L1], psqt int32[NF,8],
+    and per-stack lists fc0_b/fc0_w/fc1_b/fc1_w/fc2_b/fc2_w."""
+    l1 = net_q["ft_b"].shape[0]
+    out = bytearray()
+    out += struct.pack("<I", net_q.get("version", 0x7AF32F20))
+    out += struct.pack("<I", net_q.get("net_hash", 0x1337))
+    desc = net_q.get("description", b"fishnet-tpu synthetic test net")
+    out += struct.pack("<I", len(desc)) + desc
+
+    def emit(arr: np.ndarray, compress: bool = False):
+        nonlocal out
+        flat = arr.reshape(-1)
+        if compress:
+            payload = _leb128_encode(flat)
+            out += LEB_MAGIC + struct.pack("<I", len(payload)) + payload
+        else:
+            out += flat.astype(flat.dtype.newbyteorder("<")).tobytes()
+
+    out += struct.pack("<I", net_q.get("ft_hash", 0x5D69D5B8))
+    emit(net_q["ft_b"].astype(np.int16))
+    emit(net_q["ft_w"].astype(np.int16).reshape(-1), compress=compress_ft)
+    emit(net_q["psqt"].astype(np.int32))
+    out += struct.pack("<I", net_q.get("stack_hash", 0x63337156))
+    for b in range(NUM_STACKS):
+        emit(net_q["fc0_b"][b].astype(np.int32))
+        emit(net_q["fc0_w"][b].astype(np.int8))
+        emit(net_q["fc1_b"][b].astype(np.int32))
+        emit(net_q["fc1_w"][b].astype(np.int8))
+        emit(net_q["fc2_b"][b].astype(np.int32))
+        emit(net_q["fc2_w"][b].astype(np.int8))
+    Path(path).write_bytes(bytes(out))

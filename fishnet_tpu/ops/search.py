@@ -376,6 +376,8 @@ def search_batch_resumable(
     state = _init_state_jit(params, roots, depth, node_budget, max_ply)
     total = 0
     while total < max_steps:
+        if deadline is not None and _time.monotonic() >= deadline:
+            break  # don't dispatch (or cold-compile) a segment we'd discard
         state, n = _run_segment_jit(params, state, segment_steps)
         total += int(n)  # sync point: segment finished on device
         if int(n) < segment_steps:

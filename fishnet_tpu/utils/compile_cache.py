@@ -39,7 +39,7 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
         jax.config.update("jax_compilation_cache_dir", str(p))
         # default thresholds skip small programs; cache everything — even
         # the small host-callback programs add up across restarts
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _enabled_path = p
         return p

@@ -38,7 +38,7 @@ def test_app_end_to_end(server, tmp_path):
         text=True,
     )
     try:
-        deadline = time.time() + 60
+        deadline = time.time() + 180  # generous: shared CI boxes jitter a lot
         while time.time() < deadline and "app00001" not in server.analyses:
             time.sleep(0.1)
             if proc.poll() is not None:
