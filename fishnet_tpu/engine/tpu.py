@@ -12,6 +12,7 @@ program shapes, then caches.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import List, Optional
 
@@ -71,10 +72,19 @@ class TpuEngine:
         weights_path: Optional[str] = None,
         max_depth: int = 6,
         seed: int = 1234,
+        tt_size_log2: int = 21,  # 2M slots ≈ 24 MiB HBM; 0 disables
     ) -> None:
         from ..utils import enable_compile_cache
 
         enable_compile_cache()  # restarts reuse compiled search programs
+        # one shared transposition table for every lane and every chunk —
+        # the per-process persistent hash (reference: Stockfish's TT,
+        # ~64 MiB/core README.md:76). Concurrent workers may interleave
+        # updates; tables are immutable arrays so interleaving only loses
+        # entries, never corrupts (plus tt.py's XOR validation).
+        from ..ops import tt as tt_mod
+
+        self.tt = tt_mod.make_table(tt_size_log2) if tt_size_log2 else None
         if params is None:
             if weights_path and str(weights_path).endswith(".nnue"):
                 # real Stockfish network file (models/nnue_import.py)
@@ -96,7 +106,7 @@ class TpuEngine:
         self.params = params
         self.max_depth = max_depth
 
-    def warmup(self, buckets=LANE_BUCKETS[:2]) -> None:
+    def warmup(self, buckets=None) -> None:
         """Pre-compile the hot search program for the given lane buckets.
 
         XLA caches one program per (lane bucket, MAX_PLY) shape; without
@@ -104,13 +114,23 @@ class TpuEngine:
         (move jobs have a 7 s deadline — they would always fail cold).
         16 covers single-pv chunks; 64 covers multipv root-move lanes
         (which pad to ≥64). The reference similarly does its engine prep
-        before workers start (Assets::prepare, src/main.rs:94)."""
+        before workers start (Assets::prepare, src/main.rs:94).
+        FISHNET_TPU_WARMUP_BUCKETS="16" overrides (e.g. CPU smoke runs
+        where each extra compile costs minutes)."""
+        if buckets is None:
+            env = os.environ.get("FISHNET_TPU_WARMUP_BUCKETS")
+            buckets = (
+                tuple(int(x) for x in env.split(",") if x)
+                if env
+                else LANE_BUCKETS[:2]
+            )
         for b in buckets:
             roots = stack_boards([from_position(Position.initial())] * b)
             out = search_batch_resumable(
                 self.params, roots, jnp.ones((b,), jnp.int32),
-                jnp.full((b,), 64, jnp.int32), max_ply=MAX_PLY,
+                jnp.full((b,), 64, jnp.int32), max_ply=MAX_PLY, tt=self.tt,
             )
+            self.tt = out.pop("tt")
             jax.block_until_ready(out["nodes"])
 
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
@@ -197,8 +217,9 @@ class TpuEngine:
                 out = search_batch_resumable(
                     self.params, roots, jnp.asarray(depth_arr),
                     jnp.asarray(budget_arr), max_ply=MAX_PLY,
-                    deadline=deadline,
+                    deadline=deadline, tt=self.tt,
                 )
+                self.tt = out.pop("tt")
                 out = {k: np.asarray(v) for k, v in out.items()}
                 exhausted_all = True
                 for j, i in enumerate(lanes):
@@ -286,8 +307,9 @@ class TpuEngine:
                     jnp.asarray(depth_arr),
                     jnp.asarray(np.full(B, min(share, 2**31 - 1), np.int32)),
                     max_ply=MAX_PLY,
-                    deadline=deadline,
+                    deadline=deadline, tt=self.tt,
                 )
+                self.tt = out.pop("tt")
                 out = {k: np.asarray(v) for k, v in out.items()}
                 if not bool(out["done"][: len(children)].all()):
                     break  # deadline hit mid-depth: keep previous depth's lines

@@ -61,6 +61,7 @@ class SearchState(NamedTuple):
     midx: jnp.ndarray  # (B, P)
     searched: jnp.ndarray  # (B, P) legal children folded so far
     alpha: jnp.ndarray  # (B, P) int32
+    alpha0: jnp.ndarray  # (B, P) window lower bound at entry (for TT flags)
     beta: jnp.ndarray  # (B, P)
     best: jnp.ndarray  # (B, P)
     best_move: jnp.ndarray  # (B, P)
@@ -71,6 +72,12 @@ class SearchState(NamedTuple):
     ply: jnp.ndarray  # (B,)
     mode: jnp.ndarray  # (B,)
     ret: jnp.ndarray  # (B,) value returned by just-finished node
+    ret_depth: jnp.ndarray  # (B,) searched depth of that value (-1: from TT)
+    # leaf evals fold into their parent within ONE step (ENTER→RETURN
+    # cascade), so they are never visible at a step boundary; the step
+    # marks them here and the TT runner stores them with the pre-step hash
+    store_mark: jnp.ndarray  # (B,) bool: this step produced a leaf eval
+    store_val: jnp.ndarray  # (B,) its static eval
     nodes: jnp.ndarray  # (B,) int32 visited nodes
     depth_limit: jnp.ndarray  # (B,)
     node_budget: jnp.ndarray  # (B,)
@@ -120,12 +127,13 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
         moves=z(P, MAX_MOVES, fill=-1),
         count=z(P), midx=z(P), searched=z(P),
-        alpha=z(P, fill=-INF), beta=z(P, fill=INF),
+        alpha=z(P, fill=-INF), alpha0=z(P, fill=-INF), beta=z(P, fill=INF),
         best=z(P, fill=-INF), best_move=z(P, fill=-1),
         incheck=z(P, dtype=jnp.bool_),
         pv=z(P, P, fill=-1), pv_len=z(P),
         acc=acc,
-        ply=z(), mode=z(), ret=z(),
+        ply=z(), mode=z(), ret=z(), ret_depth=z(),
+        store_mark=z(dtype=jnp.bool_), store_val=z(),
         nodes=z(),
         depth_limit=depth.astype(jnp.int32),
         node_budget=node_budget.astype(jnp.int32),
@@ -133,12 +141,17 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
     )
 
 
-def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
+def _step_lane(params: nnue.NnueParams, s: SearchState,
+               tt_hit=None, tt_score=None, tt_move=None) -> SearchState:
     """One state-machine step for a single lane (vmapped over B).
 
     Every stack mutation is a masked *row-level* update (`at[ply].set` with
     a where-selected row): tree-level conds/selects would force XLA to copy
     whole (MAX_PLY, …) stacks per step, which dominates per-step cost.
+
+    tt_hit/tt_score: a usable transposition-table cutoff for this lane's
+    current ENTER node (probed outside the vmap against the shared table);
+    tt_move: stored best move for ordering (-1 when none). None → no TT.
     """
     # ---------------------------------------------------------- phase ENTER
     ply = s.ply
@@ -175,8 +188,32 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
 
     gen_moves, gen_count = generate_moves(b)
 
-    to_return = parent_illegal | is_leaf
+    # TT cutoff: treat as a leaf return with the stored score (never at
+    # the root — the root must produce a move; never on fifty-move draws —
+    # the hash excludes the halfmove counter, so a stored score from a
+    # lower halfmove count must not override the forced draw)
+    use_tt = (
+        (tt_hit & (ply > 0) & ~fifty) if tt_hit is not None else jnp.bool_(False)
+    )
+    to_return = parent_illegal | is_leaf | use_tt
     expand = enter & ~to_return
+    # mark fresh static-eval leaves for the runner's depth-0 TT store
+    # (fifty draws excluded: they don't transpose)
+    leaf_store = enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty
+    store_mark = leaf_store
+    store_val = jnp.where(leaf_store, leaf_val, 0)
+
+    # order the stored TT move first (classic biggest ordering win)
+    if tt_move is not None:
+        tm_at = jnp.argmax(gen_moves == tt_move)
+        tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move)
+        m0 = gen_moves[0]
+        gen_moves = gen_moves.at[jnp.where(tm_present, tm_at, 0)].set(
+            jnp.where(tm_present, m0, gen_moves[0])
+        )
+        gen_moves = gen_moves.at[0].set(
+            jnp.where(tm_present, tt_move, gen_moves[0])
+        )
 
     def row_upd(arr, val, mask):
         return arr.at[ply].set(jnp.where(mask, val, arr[ply]))
@@ -185,9 +222,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
     count = row_upd(s.count, gen_count, expand)
     midx = row_upd(s.midx, 0, expand)
     searched = row_upd(s.searched, 0, expand)
-    alpha = row_upd(
-        s.alpha, jnp.where(ply == 0, -INF, -s.beta[jnp.maximum(ply - 1, 0)]), expand
-    )
+    entry_alpha = jnp.where(ply == 0, -INF, -s.beta[jnp.maximum(ply - 1, 0)])
+    alpha = row_upd(s.alpha, entry_alpha, expand)
+    alpha0 = row_upd(s.alpha0, entry_alpha, expand)
     beta = row_upd(
         s.beta, jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)]), expand
     )
@@ -198,7 +235,19 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
     # pv_len[child_ply], which would otherwise be a stale slot
     pv_len = row_upd(s.pv_len, 0, enter)
     ret = jnp.where(
-        enter & to_return, jnp.where(parent_illegal, ILLEGAL, leaf_val), s.ret
+        enter & to_return,
+        jnp.where(
+            parent_illegal,
+            ILLEGAL,
+            jnp.where(use_tt, tt_score, leaf_val) if tt_score is not None
+            else leaf_val,
+        ),
+        s.ret,
+    )
+    # ret_depth: 0 for static leaves, -1 for TT-sourced values (already in
+    # the table — don't re-store them)
+    ret_depth = jnp.where(
+        enter & to_return, jnp.where(use_tt, -1, 0), s.ret_depth
     )
     nodes = s.nodes + jnp.where(enter & ~parent_illegal, 1, 0)
     mode = jnp.where(
@@ -284,6 +333,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
         acc = s.acc
 
     ret = jnp.where(try_m & finish, fin_val, ret)
+    ret_depth = jnp.where(
+        try_m & finish, s.depth_limit - ply, ret_depth
+    )
     mode = jnp.where(
         try_m, jnp.where(finish, MODE_RETURN, MODE_ENTER), mode
     )
@@ -292,9 +344,10 @@ def _step_lane(params: nnue.NnueParams, s: SearchState) -> SearchState:
     return SearchState(
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
         moves=moves, count=count, midx=midx, searched=searched,
-        alpha=alpha, beta=beta, best=best, best_move=best_move,
+        alpha=alpha, alpha0=alpha0, beta=beta, best=best, best_move=best_move,
         incheck=incheck, pv=pv, pv_len=pv_len, acc=acc,
-        ply=ply, mode=mode, ret=ret, nodes=nodes,
+        ply=ply, mode=mode, ret=ret, ret_depth=ret_depth,
+        store_mark=store_mark, store_val=store_val, nodes=nodes,
         depth_limit=s.depth_limit, node_budget=s.node_budget,
         root_score=root_score, root_move=root_move,
     )
@@ -305,6 +358,21 @@ def make_search_step(params: nnue.NnueParams):
         *[0 for _ in SearchState._fields]
     )
     return jax.vmap(lambda s: _step_lane(params, s), in_axes=(lane_axes,))
+
+
+def make_search_step_tt(params: nnue.NnueParams):
+    lane_axes = SearchState(
+        *[0 for _ in SearchState._fields]
+    )
+    return jax.vmap(
+        lambda s, h, sc, m: _step_lane(params, s, h, sc, m),
+        in_axes=(lane_axes, 0, 0, 0),
+    )
+
+
+def _gather_ply(arr: jnp.ndarray, ply: jnp.ndarray) -> jnp.ndarray:
+    """arr (B, P, ...) → per-lane row at each lane's ply, shape (B, ...)."""
+    return jax.vmap(lambda a, p: a[p])(arr, ply)
 
 
 # ------------------------------------------------- segmented (resumable) run
@@ -321,19 +389,92 @@ def make_search_step(params: nnue.NnueParams):
 
 
 def _run_segment(params: nnue.NnueParams, state: SearchState,
-                 segment_steps: int):
-    step = make_search_step(params)
+                 ttab, segment_steps: int):
+    """Advance all lanes ≤ segment_steps. ttab: shared tt.TTable or None.
+
+    The TT lives OUTSIDE the vmap: each iteration first stores every lane
+    parked in RETURN (its finished node's value), then probes every lane
+    in ENTER against the just-updated table, and feeds the probe results
+    into the vmapped step. Stores from one lane are visible to every
+    other lane in the same iteration — the cross-lane sharing that makes
+    one HBM table worth more than B private ones."""
+    from . import tt as tt_mod
+
+    if ttab is None:
+        step = make_search_step(params)
+
+        def body(carry):
+            s, t, i = carry
+            return step(s), t, i + 1
+    else:
+        step = make_search_step_tt(params)
+
+        def body(carry):
+            s, t, i = carry
+            bb = _gather_ply(s.board, s.ply)
+            st = _gather_ply(s.stm, s.ply)
+            epv = _gather_ply(s.ep, s.ply)
+            ca = _gather_ply(s.castling, s.ply)
+            h1, h2 = jax.vmap(tt_mod.hash_board)(bb, st, epv, ca)
+
+            # ---- store lanes whose INTERIOR node just finished. (Leaf
+            # returns fold into the parent within one step — the ENTER→
+            # RETURN cascade — so a lane parked in RETURN here always
+            # carries ret_depth >= 1, except TT-sourced values at -1.)
+            ret_m = s.mode == MODE_RETURN
+            store_mask = (
+                ret_m
+                & (s.ret != ILLEGAL)
+                & (s.ret_depth >= 1)  # -1: value came from the TT itself
+                # after budget exhaustion subtrees are degraded — their
+                # values are shallow despite the nominal depth label
+                & (s.nodes < s.node_budget)
+            )
+            beta_at = _gather_ply(s.beta, s.ply)
+            alpha0_at = _gather_ply(s.alpha0, s.ply)
+            flag = jnp.where(
+                s.ret >= beta_at,
+                tt_mod.FLAG_LOWER,
+                jnp.where(
+                    s.ret <= alpha0_at, tt_mod.FLAG_UPPER, tt_mod.FLAG_EXACT
+                ),
+            )
+            bm = _gather_ply(s.best_move, s.ply)
+            t = tt_mod.store(
+                t, h1, h2, s.ret, jnp.maximum(s.ret_depth, 0), flag, bm,
+                store_mask,
+            )
+
+            # ---- probe lanes about to enter a node (mode == ENTER)
+            enter = s.mode == MODE_ENTER
+            parent = jnp.maximum(s.ply - 1, 0)
+            a_w = jnp.where(s.ply == 0, -INF, -_gather_ply(s.beta, parent))
+            b_w = jnp.where(s.ply == 0, INF, -_gather_ply(s.alpha, parent))
+            usable, score, _mv, order_mv = tt_mod.probe(
+                t, h1, h2, s.depth_limit - s.ply, a_w, b_w
+            )
+            usable &= enter
+            order_mv = jnp.where(enter, order_mv, -1)
+            s = step(s, usable, score, order_mv)
+
+            # ---- store leaves the step just evaluated (depth-0 EXACT).
+            # Their hash is the PRE-step hash: a marking lane was in ENTER
+            # at this ply, exactly the position h1/h2 were computed for.
+            t = tt_mod.store(
+                t, h1, h2, s.store_val, jnp.zeros_like(s.store_val),
+                jnp.full_like(s.store_val, tt_mod.FLAG_EXACT),
+                jnp.full_like(s.store_val, -1), s.store_mark,
+            )
+            return s, t, i + 1
 
     def cond(carry):
-        s, i = carry
+        s, t, i = carry
         return (i < segment_steps) & jnp.any(s.mode != MODE_DONE)
 
-    def body(carry):
-        s, i = carry
-        return step(s), i + 1
-
-    state, n = jax.lax.while_loop(cond, body, (state, jnp.int32(0)))
-    return state, n
+    state, ttab, n = jax.lax.while_loop(
+        cond, body, (state, ttab, jnp.int32(0))
+    )
+    return state, ttab, n
 
 
 _run_segment_jit = jax.jit(_run_segment, static_argnames=("segment_steps",))
@@ -361,12 +502,17 @@ def search_batch_resumable(
     segment_steps: int = 20_000,
     max_steps: int = 4_000_000,
     deadline: float | None = None,
+    tt=None,
 ):
     """Like `search_batch`, but dispatched in bounded segments.
 
     deadline: absolute time.monotonic() stamp; between segments the host
     stops early when passed. Lanes not DONE at stop report done=False and
     their root_score/move must be ignored by the caller.
+
+    tt: optional shared ops.tt.TTable; the updated table is returned as
+    results["tt"] so callers can carry it across searches (the engine
+    keeps one per process, like Stockfish's persistent hash).
     """
     import time as _time
 
@@ -378,30 +524,34 @@ def search_batch_resumable(
     while total < max_steps:
         if deadline is not None and _time.monotonic() >= deadline:
             break  # don't dispatch (or cold-compile) a segment we'd discard
-        state, n = _run_segment_jit(params, state, segment_steps)
+        state, tt, n = _run_segment_jit(params, state, tt, segment_steps)
         total += int(n)  # sync point: segment finished on device
         if int(n) < segment_steps:
             break  # every lane parked in DONE
         if deadline is not None and _time.monotonic() >= deadline:
             break
-    return extract_results(state, jnp.int32(total))
+    out = extract_results(state, jnp.int32(total))
+    out["tt"] = tt
+    return out
 
 
 def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
-                 max_ply: int, max_steps: int = 2_000_000):
+                 max_ply: int, max_steps: int = 2_000_000, tt=None):
     """Run fixed-depth alpha-beta on B root positions in lockstep.
 
     Requires max_ply > max(depth): leaves live at ply == depth and need
     stack slots. Returns a dict of (B,)-shaped results; scores are
     centipawn ints from the root side to move's perspective; ±(MATE-n)
-    encodes mate in n plies.
+    encodes mate in n plies. tt: optional shared ops.tt.TTable.
     """
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
     state = init_state(params, roots, depth, node_budget, max_ply)
-    state, steps = _run_segment(params, state, max_steps)
-    return extract_results(state, steps)
+    state, tt, steps = _run_segment(params, state, tt, max_steps)
+    out = extract_results(state, steps)
+    out["tt"] = tt
+    return out
 
 
 search_batch_jit = jax.jit(search_batch, static_argnames=("max_ply", "max_steps"))

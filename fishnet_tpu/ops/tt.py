@@ -1,0 +1,141 @@
+"""Shared transposition table in HBM for the lockstep batched search.
+
+The reference's engines keep a per-process TT inside Stockfish's C++
+(fishnet sizes it via engine defaults; reference: README.md:76 "~64 MiB
+RAM per core" is mostly this table). Here ONE table is shared by every
+search lane on the chip: entries live in HBM arrays carried through the
+search while_loop, probed/stored with batched gathers/scatters.
+
+Race tolerance (SURVEY.md §7.3 "lock-free XOR trick"): a batched scatter
+with colliding indices may interleave lanes arbitrarily, and the two
+entry words are written by *separate* scatters, so an entry can be torn
+(lane A's key word with lane B's data word). Every entry therefore
+stores `check = hash2 ^ meta ^ move`; a probe recomputes the XOR and a
+torn entry simply fails validation and reads as a miss — stale or
+corrupt entries can never return a wrong score, only cost a re-search.
+
+Entry layout (3 × int32 words per slot, SoA):
+    check: hash2 ^ meta ^ move        (validation word)
+    meta:  (score+32768) << 10 | searched_depth << 2 | flag
+    move:  the node's best move encoding (-1 when none)
+Mate-range scores are never stored (ply-relative mate distances don't
+transpose; skipping them keeps the table sound without ply adjustment).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FLAG_EXACT = 0
+FLAG_LOWER = 1  # score is a lower bound (fail-high: score >= beta)
+FLAG_UPPER = 2  # score is an upper bound (fail-low: score <= alpha0)
+
+_SCORE_BIAS = 32768
+_DEPTH_MASK = 0xFF
+_MAX_STORE = 30000  # skip mate-range scores (|MATE|-1000 = 31000 > this)
+
+# two independent 32-bit zobrist tables from one seeded PRNG; host-side
+# constants baked into the program
+_rng = np.random.default_rng(0xF15F_4E7)
+_Z_SHAPE = 13 * 64 + 65 + 4 * 65 + 2  # piece-square | ep | castling | stm
+Z1 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
+Z2 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
+_EP_OFF = 13 * 64
+_CASTLE_OFF = _EP_OFF + 65
+_STM_OFF = _CASTLE_OFF + 4 * 65
+
+
+class TTable(NamedTuple):
+    check: jnp.ndarray  # (N,) uint32
+    meta: jnp.ndarray  # (N,) int32
+    move: jnp.ndarray  # (N,) int32
+
+    @property
+    def size(self) -> int:
+        return self.check.shape[0]
+
+
+def make_table(size_log2: int = 20) -> TTable:
+    """2**size_log2 slots × 12 bytes (default 2^20 ≈ 12 MiB HBM)."""
+    n = 1 << size_log2
+    return TTable(
+        check=jnp.zeros((n,), jnp.uint32),
+        meta=jnp.zeros((n,), jnp.int32),
+        move=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def hash_board(board64, stm, ep, castling):
+    """→ (h1, h2) uint32 pair for one position; batched via vmap/broadcast.
+
+    board64 (…,64) int32 codes 0..12; ep scalar -1..63; castling (…,4)
+    rook squares or -1; stm 0|1. halfmove is deliberately excluded
+    (standard engine practice: 50-move distance doesn't transpose)."""
+    sq = jnp.arange(64, dtype=jnp.int32)
+    idx = board64 * 64 + sq  # code 0 → slots 0..63, masked below
+    mask = board64 > 0
+
+    def fold(z):
+        rows = jnp.where(mask, z[idx], 0)
+        h = jax.lax.reduce(
+            rows, jnp.uint32(0), jax.lax.bitwise_xor, (rows.ndim - 1,)
+        )
+        h ^= z[_EP_OFF + ep + 1]
+        for i in range(4):
+            h ^= z[_CASTLE_OFF + i * 65 + castling[..., i] + 1]
+        h ^= z[_STM_OFF + stm]
+        return h
+
+    return fold(Z1), fold(Z2)
+
+
+def pack_meta(score, depth, flag):
+    return ((score + _SCORE_BIAS) << 10) | (depth << 2) | flag
+
+
+def unpack_meta(meta):
+    score = (meta >> 10) - _SCORE_BIAS
+    depth = (meta >> 2) & _DEPTH_MASK
+    flag = meta & 3
+    return score, depth, flag
+
+
+def probe(tt: TTable, h1, h2, depth_left, alpha, beta):
+    """Batched probe: → (usable, score, move, ordering_move).
+
+    usable: entry valid AND deep enough AND its bound cuts the (alpha,
+    beta) window. ordering_move: the stored move whenever the entry is
+    merely valid (usable for move ordering even when depth is too
+    shallow)."""
+    slot = (h1 & jnp.uint32(tt.size - 1)).astype(jnp.int32)
+    meta = tt.meta[slot]
+    move = tt.move[slot]
+    valid = (tt.check[slot] ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)) == h2
+    valid &= meta != 0
+    score, depth, flag = unpack_meta(meta)
+    deep_enough = depth >= depth_left
+    cuts = jnp.where(
+        flag == FLAG_EXACT,
+        True,
+        jnp.where(flag == FLAG_LOWER, score >= beta, score <= alpha),
+    )
+    usable = valid & deep_enough & cuts
+    return usable, score, jnp.where(usable, move, -1), jnp.where(valid, move, -1)
+
+
+def store(tt: TTable, h1, h2, score, depth, flag, move, mask):
+    """Batched store; lanes with mask=False write nothing. Always-replace
+    scheme (simple and effective for short batched searches)."""
+    storable = mask & (jnp.abs(score) <= _MAX_STORE)
+    slot = (h1 & jnp.uint32(tt.size - 1)).astype(jnp.int32)
+    slot = jnp.where(storable, slot, tt.size)  # out-of-range → dropped
+    meta = pack_meta(score, depth, flag)
+    check = h2 ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)
+    return TTable(
+        check=tt.check.at[slot].set(check, mode="drop"),
+        meta=tt.meta.at[slot].set(meta, mode="drop"),
+        move=tt.move.at[slot].set(move, mode="drop"),
+    )

@@ -35,6 +35,12 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
             or os.environ.get("FISHNET_TPU_COMPILE_CACHE")
             or Path.home() / ".cache" / "fishnet-tpu" / "xla"
         )
+        # namespace by backend: entries written through a remote-TPU
+        # plugin target the REMOTE host's CPU features; loading them in a
+        # local CPU run fails per-program (feature mismatch) and turns
+        # every tiny eager compile into a load-fail-recompile-rewrite
+        # cycle that can stall startup for minutes
+        p = p / jax.default_backend()
         p.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(p))
         # default thresholds skip small programs; cache everything — even

@@ -22,7 +22,10 @@ def server():
 
 
 def test_app_end_to_end(server, tmp_path):
-    server.add_analysis_job("app00001", START, ["e2e4", "e7e5"], timeout_ms=5000)
+    # generous per-ply timeout: the chunk deadline is timeout × plies and
+    # the pure-python engine needs ~15 s for 3 plies on a busy CI box —
+    # 5000 ms/ply put the deadline right at the edge (flaky under load)
+    server.add_analysis_job("app00001", START, ["e2e4", "e7e5"], timeout_ms=40000)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     proc = subprocess.Popen(
@@ -44,7 +47,10 @@ def test_app_end_to_end(server, tmp_path):
             if proc.poll() is not None:
                 out = proc.stdout.read()
                 pytest.fail(f"client exited early ({proc.returncode}):\n{out}")
-        assert "app00001" in server.analyses, "no analysis submitted"
+        if "app00001" not in server.analyses:
+            proc.kill()
+            out, _ = proc.communicate()
+            pytest.fail(f"no analysis submitted; client output:\n{out[-4000:]}")
         proc.send_signal(signal.SIGINT)
         try:
             proc.wait(timeout=30)
