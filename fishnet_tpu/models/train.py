@@ -25,7 +25,14 @@ from . import nnue
 
 def batched_forward(params: nnue.NnueParams, boards: jnp.ndarray,
                     stms: jnp.ndarray) -> jnp.ndarray:
-    """(B, 64) boards, (B,) stms → (B,) centipawn scores."""
+    """(B, 64) boards, (B,) stms → (B,) centipawn scores.
+
+    FISHNET_TPU_PALLAS=1 routes board768 nets through the fused Pallas
+    kernel (ops/pallas_nnue.py); default is the XLA path."""
+    from ..ops import pallas_nnue
+
+    if pallas_nnue.is_enabled() and nnue.is_board768(params):
+        return pallas_nnue.evaluate_batch_trainable(params, boards, stms)
     return jax.vmap(nnue.evaluate, in_axes=(None, 0, 0))(params, boards, stms)
 
 

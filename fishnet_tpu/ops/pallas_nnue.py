@@ -1,0 +1,174 @@
+"""Fused batched NNUE evaluation as a Pallas TPU kernel.
+
+The XLA path (models/nnue.py) evaluates a board batch as separate ops:
+feature-index gather, feature-transform row sums, clipped ReLU, three
+bucketed matmuls. This kernel fuses the whole pipeline per batch tile in
+VMEM (SURVEY.md §7.2's "fused int8 matmul→clipped-ReLU stack", float
+variant):
+
+  boards (T, 64) ──► one-hot features (T, 768) built in-register via an
+  iota compare (no scatter) ──► (T, 768) @ ft_w (768, L1) on the MXU ──►
+  perspective select + clipped ReLU ──► dense head over ALL 8 output
+  buckets at once — (8,) small matmuls are cheaper than per-lane weight
+  gathers on TPU — ──► per-lane bucket select ──► (T,) centipawn scores.
+
+Dense-over-buckets is the TPU-first trade: 8× the head FLOPs (trivial —
+the head is tiny) for zero gather/scatter in the hot path.
+
+Used by models/train.py's batched_forward when FISHNET_TPU_PALLAS=1 and
+on CPU test runs via interpret mode; the XLA path stays the default
+until the kernel is profiled on real hardware. board768 feature set
+only (the search's incremental path has its own accumulators).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import nnue
+
+TILE = 8  # lanes per grid step; f32 min sublane tile
+
+
+def _kernel(boards_ref, stm_ref, ft_w_ref, ft_b_ref, l1_w_ref, l1_b_ref,
+            l2_w_ref, l2_b_ref, out_w_ref, out_b_ref, out_ref):
+    boards = boards_ref[:]  # (T, 64) int32 piece codes
+    stm = stm_ref[:]  # (T,) int32
+
+    nf = ft_w_ref.shape[0]  # 768
+    l1 = ft_w_ref.shape[1]
+
+    def onehot_features(perspective):
+        # board768 feature index per square, -1 when empty (mirrors
+        # nnue.feature_indices_768, kept in-kernel so everything fuses)
+        sq = jax.lax.broadcasted_iota(jnp.int32, (TILE, 64), 1)
+        code = boards
+        pt = (code - 1) % 6
+        col = jnp.where(code > 6, 1, 0)
+        persp = perspective[:, None]
+        kind = jnp.where(col == persp, pt, 6 + pt)
+        o_sq = sq ^ jnp.where(persp == 1, 56, 0)
+        idx = jnp.where(code > 0, kind * 64 + o_sq, -1)  # (T, 64)
+        # one-hot via compare against a feature iota: (T, 64, NF) reduce
+        # over squares → (T, NF). No scatter; lowers to VPU compares.
+        feat = jax.lax.broadcasted_iota(jnp.int32, (TILE, 64, nf), 2)
+        onehot = (feat == idx[:, :, None]).astype(jnp.float32)
+        return onehot.sum(axis=1)  # (T, NF)
+
+    own = onehot_features(stm)
+    opp = onehot_features(1 - stm)
+    ft_w = ft_w_ref[:]
+    ft_b = ft_b_ref[:]
+    acc_own = own @ ft_w + ft_b  # (T, L1) — MXU
+    acc_opp = opp @ ft_w + ft_b
+
+    x = jnp.clip(jnp.concatenate([acc_own, acc_opp], axis=1), 0.0, 1.0)
+
+    # dense over all 8 output buckets, select per lane at the end
+    piece_count = (boards > 0).sum(axis=1)  # (T,)
+    bucket = jnp.clip((piece_count - 1) // 4, 0, nnue.NUM_OUTPUT_BUCKETS - 1)
+
+    l1_w = l1_w_ref[:]  # (8, 2*L1, H1)
+    l2_w = l2_w_ref[:]  # (8, H1, H2)
+    out_w = out_w_ref[:]  # (8, H2)
+    h = jnp.clip(
+        jnp.einsum("tc,bch->bth", x, l1_w) + l1_b_ref[:][:, None, :], 0.0, 1.0
+    )  # (8, T, H1)
+    h = jnp.clip(
+        jnp.einsum("bth,bhk->btk", h, l2_w) + l2_b_ref[:][:, None, :], 0.0, 1.0
+    )  # (8, T, H2)
+    o = jnp.einsum("btk,bk->bt", h, out_w) + out_b_ref[:][:, None]  # (8, T)
+
+    lane_bucket_onehot = (
+        jax.lax.broadcasted_iota(jnp.int32, (nnue.NUM_OUTPUT_BUCKETS, TILE), 0)
+        == bucket[None, :]
+    ).astype(jnp.float32)
+    out_ref[:] = (o * lane_bucket_onehot).sum(axis=0) * nnue.OUTPUT_SCALE
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def evaluate_batch(params: nnue.NnueParams, boards: jnp.ndarray,
+                   stms: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """(B, 64) boards, (B,) stms → (B,) centipawn scores (board768 nets).
+
+    interpret=True runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    from jax.experimental import pallas as pl
+
+    if params.ft_w.shape[0] != nnue.NUM_FEATURES_768:
+        raise ValueError("pallas kernel supports the board768 feature set only")
+    if not interpret and jax.default_backend() == "cpu":
+        interpret = True  # Mosaic doesn't lower to host CPU; emulate
+    B = boards.shape[0]
+    pad = (-B) % TILE
+    if pad:
+        boards = jnp.concatenate(
+            [boards, jnp.zeros((pad, 64), boards.dtype)], axis=0
+        )
+        stms = jnp.concatenate([stms, jnp.zeros((pad,), stms.dtype)], axis=0)
+    n = boards.shape[0]
+
+    f32 = lambda a: jnp.asarray(a, jnp.float32)  # noqa: E731
+    grid = (n // TILE,)
+    lane_spec = pl.BlockSpec((TILE, 64), lambda i: (i, 0))
+    stm_spec = pl.BlockSpec((TILE,), lambda i: (i,))
+    full = lambda a: pl.BlockSpec(a.shape, lambda i: (0,) * a.ndim)  # noqa: E731
+
+    args = (
+        boards.astype(jnp.int32), stms.astype(jnp.int32),
+        f32(params.ft_w), f32(params.ft_b),
+        f32(params.l1_w), f32(params.l1_b),
+        f32(params.l2_w), f32(params.l2_b),
+        f32(params.out_w), f32(params.out_b),
+    )
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[lane_spec, stm_spec] + [full(a) for a in args[2:]],
+        out_specs=pl.BlockSpec((TILE,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return out[:B]
+
+
+def is_enabled() -> bool:
+    import os
+
+    return bool(os.environ.get("FISHNET_TPU_PALLAS"))
+
+
+# ------------------------------------------------------- differentiable wrap
+#
+# pallas_call has no built-in autodiff; training needs d(score)/d(params).
+# Standard pattern (pallas guide §custom-vjp): run the fused kernel
+# forward, compute the backward with the XLA reference path — backward
+# cost dominates training anyway, and the two forwards agree to f32
+# tolerance (tests/test_pallas_nnue.py).
+
+
+def _xla_forward(params, boards, stms):
+    return jax.vmap(nnue.evaluate, in_axes=(None, 0, 0))(params, boards, stms)
+
+
+@jax.custom_vjp
+def evaluate_batch_trainable(params, boards, stms):
+    return evaluate_batch(params, boards, stms)
+
+
+def _fwd(params, boards, stms):
+    return evaluate_batch(params, boards, stms), (params, boards, stms)
+
+
+def _bwd(res, g):
+    params, boards, stms = res
+    _, vjp = jax.vjp(lambda p: _xla_forward(p, boards, stms), params)
+    (gp,) = vjp(g)
+    zero_i = lambda a: np.zeros(a.shape, dtype=jax.dtypes.float0)  # noqa: E731
+    return gp, zero_i(boards), zero_i(stms)
+
+
+evaluate_batch_trainable.defvjp(_fwd, _bwd)
