@@ -53,8 +53,10 @@ def _capture_key(victim_type: jnp.ndarray, attacker_type: jnp.ndarray,
 
 
 def generate_moves(b: Board):
-    """→ (moves (MAX_MOVES,) int32 sorted by ordering key, count ()).
+    """→ (moves (MAX_MOVES,) sorted by ordering key, count (), noisy ()).
 
+    noisy = how many leading moves are captures / queen promotions (they
+    sort first) — the quiescence search expands only those.
     Moves are encoded from | to<<6 | promo<<12; castling is king-takes-rook.
     """
     board = b.board
@@ -235,7 +237,9 @@ def generate_moves(b: Board):
 
     # order: stable sort by key so captures/promotions are searched first
     order = jnp.argsort(keys, stable=True)
-    return moves[order], count
+    # captures 100..739, queen promos down to 10; castling 900, quiets 1000
+    noisy = jnp.sum(keys < 900).astype(jnp.int32)
+    return moves[order], count, noisy
 
 
 v_generate_moves = jax.vmap(generate_moves, in_axes=(Board(0, 0, 0, 0, 0),))

@@ -171,7 +171,12 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     depth_left = s.depth_limit - ply
     over_budget = s.nodes >= s.node_budget
     fifty = b.halfmove >= 100
-    is_leaf = (depth_left <= 0) | fifty | over_budget
+    # quiescence: past the nominal depth, keep expanding CAPTURES until
+    # the position is quiet (gen_noisy == 0), the stack is full, or the
+    # budget runs out — the standard horizon-effect fix, with stand-pat
+    # as the floor (see the expand section below)
+    in_qs = depth_left <= 0
+    stack_full = ply >= s.moves.shape[0]  # no moves row / child slot left
 
     # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
     # path the accumulator came down the stack incrementally and only the
@@ -186,7 +191,16 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
     leaf_val = jnp.where(fifty, DRAW, leaf_val)
 
-    gen_moves, gen_count = generate_moves(b)
+    gen_moves, gen_count, gen_noisy = generate_moves(b)
+    is_leaf = (
+        fifty | over_budget | stack_full | (in_qs & (gen_noisy == 0))
+    )
+    # stand-pat beta cutoff: in QS the static eval is already >= beta —
+    # the opponent wouldn't enter this line; fail high immediately
+    stand_pat_cut = in_qs & (
+        leaf_val >= jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)])
+    )
+    is_leaf |= stand_pat_cut
 
     # TT cutoff: treat as a leaf return with the stored score (never at
     # the root — the root must produce a move; never on fifty-move draws —
@@ -197,16 +211,23 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     )
     to_return = parent_illegal | is_leaf | use_tt
     expand = enter & ~to_return
-    # mark fresh static-eval leaves for the runner's depth-0 TT store
+    # mark fresh static-eval leaves for the runner's depth-0 TT store.
+    # Quiet positions only: a quiet static eval IS the node's QS value,
+    # while a noisy leaf (budget/stack cutoff) stored as depth-0 EXACT
+    # would later short-circuit a real QS expansion of the same position.
     # (fifty draws excluded: they don't transpose)
-    leaf_store = enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty
+    leaf_store = (
+        enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty
+        & (gen_noisy == 0)
+    )
     store_mark = leaf_store
     store_val = jnp.where(leaf_store, leaf_val, 0)
 
-    # order the stored TT move first (classic biggest ordering win)
+    # order the stored TT move first (classic biggest ordering win); not
+    # in QS, where the swap could pull a quiet move into the noisy prefix
     if tt_move is not None:
         tm_at = jnp.argmax(gen_moves == tt_move)
-        tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move)
+        tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move) & ~in_qs
         m0 = gen_moves[0]
         gen_moves = gen_moves.at[jnp.where(tm_present, tm_at, 0)].set(
             jnp.where(tm_present, m0, gen_moves[0])
@@ -218,17 +239,27 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     def row_upd(arr, val, mask):
         return arr.at[ply].set(jnp.where(mask, val, arr[ply]))
 
-    moves = s.moves.at[ply].set(jnp.where(expand, gen_moves, s.moves[ply]))
-    count = row_upd(s.count, gen_count, expand)
+    moves = s.moves.at[jnp.minimum(ply, s.moves.shape[0] - 1)].set(
+        jnp.where(expand, gen_moves, s.moves[jnp.minimum(ply, s.moves.shape[0] - 1)])
+    )
+    # QS nodes expand only the noisy prefix of the sorted move list
+    count = row_upd(s.count, jnp.where(in_qs, gen_noisy, gen_count), expand)
     midx = row_upd(s.midx, 0, expand)
     searched = row_upd(s.searched, 0, expand)
     entry_alpha = jnp.where(ply == 0, -INF, -s.beta[jnp.maximum(ply - 1, 0)])
-    alpha = row_upd(s.alpha, entry_alpha, expand)
+    # stand-pat: in QS the node may decline every capture and keep the
+    # static eval, so it floors both best and alpha
+    qs_floor = in_qs & expand
+    alpha = row_upd(
+        s.alpha,
+        jnp.where(qs_floor, jnp.maximum(entry_alpha, leaf_val), entry_alpha),
+        expand,
+    )
     alpha0 = row_upd(s.alpha0, entry_alpha, expand)
     beta = row_upd(
         s.beta, jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)]), expand
     )
-    best = row_upd(s.best, -INF, expand)
+    best = row_upd(s.best, jnp.where(qs_floor, leaf_val, -INF), expand)
     best_move = row_upd(s.best_move, -1, expand)
     incheck = row_upd(s.incheck, we_are_checked, enter)
     # leaf nodes must also zero pv_len: the fold at the parent reads
@@ -302,8 +333,11 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     finish = exhausted | cutoff
     advance = try_m & ~finish
 
-    # finished node value: best, or mate/stalemate when no legal child
-    no_legal = searched[ply] == 0
+    # finished node value: best, or mate/stalemate when no legal child.
+    # QS nodes only tried captures — no legal capture is NOT mate; their
+    # stand-pat floor in `best` already covers the quiet alternatives.
+    node_in_qs = (s.depth_limit - ply) <= 0
+    no_legal = (searched[ply] == 0) & ~node_in_qs
     mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
     fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
 
@@ -537,10 +571,13 @@ def search_batch_resumable(
 
 def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
                  max_ply: int, max_steps: int = 2_000_000, tt=None):
-    """Run fixed-depth alpha-beta on B root positions in lockstep.
+    """Run fixed-depth alpha-beta + capture quiescence on B roots in
+    lockstep.
 
-    Requires max_ply > max(depth): leaves live at ply == depth and need
-    stack slots. Returns a dict of (B,)-shaped results; scores are
+    Requires max_ply > max(depth): past the nominal depth the search
+    keeps expanding captures (quiescence with stand-pat) until quiet or
+    until the max_ply stack runs out, so max_ply - depth is the QS
+    headroom. Returns a dict of (B,)-shaped results; scores are
     centipawn ints from the root side to move's perspective; ±(MATE-n)
     encodes mate in n plies. tt: optional shared ops.tt.TTable.
     """

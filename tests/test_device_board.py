@@ -35,7 +35,7 @@ def kernels():
 
 
 def device_move_set(gen, pos: Position):
-    moves, count = gen(from_position(pos))
+    moves, count, _noisy = gen(from_position(pos))
     return set(np.asarray(moves)[: int(count)].tolist())
 
 
