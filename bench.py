@@ -87,29 +87,61 @@ def main() -> None:
     DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
     BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
 
-    nps = None
+    # ramp up through configs so a crash at the big shape still leaves the
+    # largest WORKING number on record (r1 recorded nothing because all
+    # attempts used the big shape). Each stage retries once.
+    stages = [(8, 2), (64, 3), (B, DEPTH)]
+    best = None  # (nps, b, d)
     last_err = None
-    attempts = ((B, DEPTH), (B, DEPTH), (min(64, B), min(3, DEPTH)))
-    for attempt, (b, d) in enumerate(attempts):
-        try:
-            nps = run_once(b, d, BUDGET)
-            B, DEPTH = b, d
-            break
-        except Exception as e:  # device/tunnel flake: retry, then shrink
-            last_err = e
-            print(f"bench attempt {attempt} (B={b}, depth={d}) failed: {e}",
-                  file=sys.stderr)
-            if attempt + 1 < len(attempts):
+    for b, d in stages:
+        ok = False
+        for attempt in range(2):
+            try:
+                t0 = time.perf_counter()
+                nps = run_once(b, d, BUDGET)
+                dt = time.perf_counter() - t0
+                print(f"bench stage B={b} depth={d}: {nps:,.0f} nodes/s "
+                      f"({dt:.1f}s incl. warmup)", file=sys.stderr)
+                best = (nps, b, d)
+                ok = True
+                break
+            except Exception as e:
+                last_err = e
+                print(f"bench stage (B={b}, depth={d}) attempt {attempt} "
+                      f"failed: {e}", file=sys.stderr)
                 time.sleep(10.0)
-    if nps is None:
-        raise SystemExit(f"bench failed after retries: {last_err}")
+        if not ok:
+            break  # don't push a crashing device harder
 
+    label = ""
+    if best is None:
+        # device unusable: measure the same program on CPU so the record
+        # is a clearly-labelled fallback number, not a crash log
+        print(f"device bench failed entirely ({last_err}); "
+              "falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+            import jax._src.xla_bridge as _xb
+
+            _xb._backend_factories.pop("axon", None)
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        nps = run_once(16, 2, BUDGET)
+        best = (nps, 16, 2)
+        label = " [CPU FALLBACK — device crashed]"
+
+    nps, b, d = best
     cores = os.cpu_count() or 1
     baseline = 400_000 * cores  # reference NPS prior × host cores
     print(
         json.dumps(
             {
-                "metric": f"batched alpha-beta+NNUE nodes/sec/chip (B={B}, depth={DEPTH})",
+                "metric": (
+                    f"batched alpha-beta+NNUE nodes/sec/chip "
+                    f"(B={b}, depth={d}){label}"
+                ),
                 "value": round(nps),
                 "unit": "nodes/sec",
                 "vs_baseline": round(nps / baseline, 4),

@@ -15,9 +15,12 @@ from typing import Optional
 
 ASSET_DIR = Path(__file__).resolve().parent / "assets"
 
+# one packaged net per feature set actually shipped in fishnet_tpu/assets/
+# (board768 is the engine fast path; a halfkav2_hm asset would slot in here
+# the moment one is trained/imported — models/nnue_import.py reads real
+# Stockfish .nnue files directly when the operator provides one)
 DEFAULT_NETS = {
     "board768": "nnue-board768-64.npz",
-    "halfkav2_hm": "nnue-hkav2-64.npz",
 }
 
 

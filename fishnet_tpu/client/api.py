@@ -10,7 +10,9 @@ to stop (reference: src/api.rs:649-678, doc/protocol.md:240-244).
 from __future__ import annotations
 
 import asyncio
+import http.client
 import json
+import threading
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
@@ -73,20 +75,66 @@ class HttpResponse:
 
 
 class UrllibTransport:
-    """Blocking stdlib transport, run on the event loop's executor."""
+    """Blocking stdlib transport, run on the event loop's executor.
+
+    Connections are kept alive and reused per host (reference uses a
+    pooled reqwest client with 25 s idle, src/main.rs:427-456 — a fresh
+    TLS handshake per acquire/submit would dominate small-request
+    latency). A connection that died while idle is retried once on a
+    fresh one."""
+
+    IDLE_TIMEOUT_S = 25.0  # reference: src/main.rs:452 pool_idle_timeout
 
     def __init__(self, timeout: float = 30.0):
         self.timeout = timeout  # reference: src/main.rs:451 (30 s)
+        self._lock = threading.Lock()
+        self._pool: dict = {}  # (scheme, netloc) -> [(conn, last_used)]
+
+    def _get_conn(self, scheme: str, netloc: str):
+        import time as _time
+
+        with self._lock:
+            entries = self._pool.get((scheme, netloc), [])
+            while entries:
+                conn, last = entries.pop()
+                if _time.monotonic() - last < self.IDLE_TIMEOUT_S:
+                    return conn
+                conn.close()
+        if scheme == "https":
+            return http.client.HTTPSConnection(netloc, timeout=self.timeout)
+        return http.client.HTTPConnection(netloc, timeout=self.timeout)
+
+    def _put_conn(self, scheme: str, netloc: str, conn) -> None:
+        import time as _time
+
+        with self._lock:
+            self._pool.setdefault((scheme, netloc), []).append(
+                (conn, _time.monotonic())
+            )
 
     def request(
         self, method: str, url: str, headers: dict, body: Optional[bytes]
     ) -> HttpResponse:
-        req = urllib.request.Request(url, data=body, headers=headers, method=method)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return HttpResponse(resp.status, resp.read())
-        except urllib.error.HTTPError as e:
-            return HttpResponse(e.code, e.read())
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        last_exc: Optional[Exception] = None
+        for attempt in range(2):  # retry once on a stale kept-alive socket
+            conn = self._get_conn(parts.scheme, parts.netloc)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._put_conn(parts.scheme, parts.netloc, conn)
+                return HttpResponse(resp.status, data)
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                conn.close()
+                last_exc = e
+        raise last_exc  # type: ignore[misc]
 
 
 class ApiError(Exception):

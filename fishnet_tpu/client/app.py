@@ -19,6 +19,7 @@ from .configure import Config
 from .logger import Logger
 from .queue import BacklogOpt, Queue
 from .stats import StatsRecorder
+from .update import DEFAULT_BUCKET_URL, auto_update, restart_process
 from .wire import EngineFlavor
 from .workers import worker
 
@@ -26,11 +27,22 @@ SUMMARY_INTERVAL_S = 120.0  # reference: src/main.rs:202-214
 UPDATE_INTERVAL_S = 5 * 3600.0  # reference: src/main.rs:180-200
 
 
+async def _http_get(url: str) -> bytes:
+    import urllib.request
+
+    def fetch() -> bytes:
+        with urllib.request.urlopen(url, timeout=30.0) as r:
+            return r.read()
+
+    return await asyncio.to_thread(fetch)
+
+
 def tpu_variants_for(cfg: Config) -> Optional[Set[str]]:
     if cfg.backend != "tpu":
         return None
-    # the TPU engine currently handles orthodox chess movegen
-    return {"standard", "chess960", "fromPosition"}
+    # orthodox movegen + the device-side variant programs
+    # (engine/tpu.py DEVICE_VARIANTS; ops/ variant static flags)
+    return {"standard", "chess960", "fromPosition", "threeCheck", "crazyhouse"}
 
 
 def make_engine_factory(cfg: Config, logger: Logger):
@@ -65,6 +77,19 @@ async def run(cfg: Config) -> int:
     logger = Logger(verbose=cfg.verbose)
     logger.headline(f"fishnet-tpu starting ({cfg.cores} cores, backend={cfg.backend})")
 
+    bucket_url = os.environ.get("FISHNET_TPU_UPDATE_URL", DEFAULT_BUCKET_URL)
+    if cfg.auto_update:
+        # startup check (reference: src/main.rs:50-68): update THEN exec a
+        # fresh process so work starts on the new version
+        try:
+            new_version = await auto_update(_http_get, bucket_url, logger)
+        except Exception as e:
+            logger.warn(f"Auto-update check failed: {e}")
+            new_version = None
+        if new_version:
+            logger.headline(f"Updated to {new_version}; restarting ...")
+            restart_process()
+
     if cfg.cpu_priority == "min":
         try:
             os.nice(19)  # reference: src/main.rs:163-171
@@ -90,6 +115,10 @@ async def run(cfg: Config) -> int:
         stats=stats,
         logger=logger,
         tpu_variants=tpu_variants_for(cfg),
+        # play jobs ride the TPU engine too (skill semantics in
+        # engine/tpu.py _move_job; reference runs them on the bundled
+        # MultiVariant engine, src/queue.rs:562-568)
+        tpu_moves=cfg.backend == "tpu",
         max_backoff_s=cfg.max_backoff,
     )
 
@@ -150,6 +179,32 @@ async def run(cfg: Config) -> int:
 
     summary = asyncio.ensure_future(summary_loop())
 
+    restart_after_drain = False
+
+    async def update_loop():
+        # 5-hourly background check (reference: src/main.rs:180-200): on a
+        # new release, stop acquiring, let pending batches drain, restart
+        nonlocal restart_after_drain
+        while True:
+            await asyncio.sleep(UPDATE_INTERVAL_S)
+            if not cfg.auto_update:
+                continue
+            try:
+                new_version = await auto_update(_http_get, bucket_url, logger)
+            except Exception as e:
+                logger.warn(f"Auto-update check failed: {e}")
+                continue
+            if new_version:
+                logger.headline(
+                    f"Updated to {new_version}; finishing pending batches "
+                    "before restart ..."
+                )
+                restart_after_drain = True
+                queue.stop_acquiring()
+                return
+
+    updater = asyncio.ensure_future(update_loop())
+
     stopper = asyncio.ensure_future(hard_stop.wait())
     done, _ = await asyncio.wait(
         tasks + [stopper], return_when=asyncio.FIRST_COMPLETED
@@ -159,18 +214,32 @@ async def run(cfg: Config) -> int:
     await asyncio.gather(*tasks, return_exceptions=True)
     stopper.cancel()
     summary.cancel()
+    updater.cancel()
     await queue.shutdown()
     await queue.drain_submissions()
     stats.close()
+    if restart_after_drain:
+        logger.headline("Restarting into the updated version ...")
+        restart_process()  # exec: replaces this process (src/main.rs:399-425)
     logger.headline("Bye.")
     return 0
+
+
+def _sync_check_key(endpoint: str, key: str) -> bool:
+    """Online key validation for the first-run dialog (reference:
+    src/configure.rs:487-498 spawns an ApiActor just for check_key)."""
+    try:
+        api = ApiClient(Endpoint(endpoint), key, logger=Logger(verbose=0))
+        return asyncio.run(api.check_key())
+    except Exception:
+        return True  # network trouble: accept and let `run` find out
 
 
 def main(argv=None) -> int:
     from .configure import parse_and_configure
     from .systemd import system_unit, user_unit
 
-    cfg = parse_and_configure(argv)
+    cfg = parse_and_configure(argv, check_key=_sync_check_key)
     if cfg.command == "license":
         print("fishnet-tpu is free software distributed under GPLv3+ terms,")
         print("matching the licensing of the fishnet protocol ecosystem.")

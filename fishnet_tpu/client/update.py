@@ -21,6 +21,10 @@ from typing import List, Optional
 _S3_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 _VERSION_RE = re.compile(r"v?(\d+)\.(\d+)\.(\d+)")
 
+# release channel (reference: src/update.rs:24 fishnet-releases bucket);
+# FISHNET_TPU_UPDATE_URL overrides (e.g. a local fixture in tests)
+DEFAULT_BUCKET_URL = "https://fishnet-tpu-releases.s3.amazonaws.com/"
+
 
 def current_target() -> str:
     """Target triple analogue, e.g. linux-x86_64 (gnu→musl mapping of the

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 import time
 from typing import List, Optional
 
@@ -38,6 +39,8 @@ LANE_BUCKETS = (16, 64, 128, 256)
 
 def _decode_uci(m: int) -> str:
     frm, to, promo = m & 63, (m >> 6) & 63, (m >> 12) & 7
+    if (m >> 15) & 1:  # crazyhouse drop: P@e4 style
+        return "PNBRQ"[promo & 7] + "@" + "abcdefgh"[to & 7] + str((to >> 3) + 1)
     s = (
         "abcdefgh"[frm & 7] + str((frm >> 3) + 1)
         + "abcdefgh"[to & 7] + str((to >> 3) + 1)
@@ -45,6 +48,18 @@ def _decode_uci(m: int) -> str:
     if promo:
         s += " nbrq"[promo]
     return s
+
+
+# chunk.variant → device search program (ops/search.py static flag);
+# variants not listed fall back to host engines via the planner routing
+DEVICE_VARIANTS = {
+    "standard": "standard",
+    "chess960": "standard",
+    "fromPosition": "standard",
+    "threeCheck": "threeCheck",
+    "3check": "threeCheck",
+    "crazyhouse": "crazyhouse",
+}
 
 
 def _score_from_int(v: int, root_ply_to_mate_sign: int = 1) -> Score:
@@ -77,14 +92,30 @@ class TpuEngine:
         from ..utils import enable_compile_cache
 
         enable_compile_cache()  # restarts reuse compiled search programs
+        # all chips on the host run one sharded program: lanes shard over a
+        # 1-D mesh and each device advances its shard independently — the
+        # TPU equivalent of the reference's engine-process-per-core
+        # (src/main.rs:151-161). Single-device hosts skip the mesh.
+        from ..parallel.mesh import make_mesh, make_sharded_table
+
+        n_dev = len(jax.devices())
+        self.mesh = make_mesh() if n_dev > 1 else None
+        self.n_dev = n_dev if self.mesh is not None else 1
         # one shared transposition table for every lane and every chunk —
         # the per-process persistent hash (reference: Stockfish's TT,
-        # ~64 MiB/core README.md:76). Concurrent workers may interleave
-        # updates; tables are immutable arrays so interleaving only loses
-        # entries, never corrupts (plus tt.py's XOR validation).
+        # ~64 MiB/core README.md:76). Sharded per device under the mesh.
+        # Chunks are dispatched one at a time (self._lock): concurrent
+        # executor threads would otherwise interleave whole-table swaps
+        # and silently discard each other's stores.
         from ..ops import tt as tt_mod
 
-        self.tt = tt_mod.make_table(tt_size_log2) if tt_size_log2 else None
+        if not tt_size_log2:
+            self.tt = None
+        elif self.mesh is not None:
+            self.tt = make_sharded_table(self.mesh, tt_size_log2)
+        else:
+            self.tt = tt_mod.make_table(tt_size_log2)
+        self._lock = threading.Lock()
         if params is None:
             if weights_path and str(weights_path).endswith(".nnue"):
                 # real Stockfish network file (models/nnue_import.py)
@@ -125,13 +156,11 @@ class TpuEngine:
                 else LANE_BUCKETS[:2]
             )
         for b in buckets:
+            b = self._pad(b)
             roots = stack_boards([from_position(Position.initial())] * b)
-            out = search_batch_resumable(
-                self.params, roots, jnp.ones((b,), jnp.int32),
-                jnp.full((b,), 64, jnp.int32), max_ply=MAX_PLY, tt=self.tt,
+            self._search(
+                roots, np.ones(b, np.int32), np.full(b, 64, np.int32)
             )
-            self.tt = out.pop("tt")
-            jax.block_until_ready(out["nodes"])
 
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
         loop = asyncio.get_running_loop()
@@ -147,7 +176,30 @@ class TpuEngine:
 
     # ----------------------------------------------------------------- sync
 
+    def _pad(self, n: int) -> int:
+        b = _pad_lanes(n)
+        if b % self.n_dev:
+            b = ((b + self.n_dev - 1) // self.n_dev) * self.n_dev
+        return b
+
+    def _search(self, roots, depth_arr, budget_arr, deadline=None,
+                variant="standard"):
+        # the TT is shared across variants: variant state is hashed into
+        # the key (ops/tt.py), so entries can't collide across rule sets
+        out = search_batch_resumable(
+            self.params, roots, jnp.asarray(depth_arr),
+            jnp.asarray(budget_arr), max_ply=MAX_PLY,
+            deadline=deadline, tt=self.tt, mesh=self.mesh,
+            variant=variant,
+        )
+        self.tt = out.pop("tt")
+        return {k: np.asarray(v) for k, v in out.items()}
+
     def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
+        with self._lock:
+            return self._go_multiple_locked(chunk)
+
+    def _go_multiple_locked(self, chunk: Chunk) -> List[PositionResponse]:
         started = time.monotonic()
         positions = []
         for wp in chunk.positions:
@@ -157,15 +209,12 @@ class TpuEngine:
             positions.append(pos)
 
         work = chunk.work
-        if isinstance(work, AnalysisWork):
-            multipv = work.effective_multipv()
-            target_depth = min(work.depth or self.max_depth, self.max_depth, MAX_PLY - 1)
-            budget = work.nodes.get(chunk.flavor.eval_flavor())
-        else:
-            assert isinstance(work, MoveWork)
-            multipv = 1
-            target_depth = min(work.level.depth, self.max_depth, MAX_PLY - 1)
-            budget = None
+        if isinstance(work, MoveWork):
+            return self._move_job(chunk, positions, work, started)
+        assert isinstance(work, AnalysisWork)
+        multipv = work.effective_multipv()
+        target_depth = min(work.depth or self.max_depth, self.max_depth, MAX_PLY - 1)
+        budget = work.nodes.get(chunk.flavor.eval_flavor())
 
         if multipv > 1:
             responses = self._analyse_multipv(
@@ -174,6 +223,90 @@ class TpuEngine:
         else:
             responses = self._analyse_single(
                 chunk, positions, target_depth, budget, started
+            )
+        return responses
+
+    def _move_job(self, chunk, positions, work: MoveWork, started):
+        """Play jobs with lichess skill semantics (reference:
+        src/api.rs:248-283 maps level 1-8 → movetime/Skill Level/depth;
+        src/stockfish.rs:309-333 passes them to the engine).
+
+        Root moves become lanes (one depth-1 search per legal move, deepened
+        iteratively); weakening is the TPU-native analog of Stockfish's
+        "Skill Level": below full strength, the move is drawn from the
+        near-best candidates with probability decaying in the cp gap, with
+        the acceptance window widening as the engine skill drops."""
+        import math
+        import random
+
+        level = work.level
+        target_depth = min(level.depth, self.max_depth, MAX_PLY - 1)
+        hard_deadline = chunk.deadline - 0.25  # 7 s job deadline
+        # movetime is a soft budget for DEEPENING; depth 1 always runs to
+        # completion under the hard deadline so a move is always produced
+        soft_deadline = min(
+            hard_deadline, started + level.movetime_ms / 1000.0
+        )
+
+        responses = []
+        for wp, pos in zip(chunk.positions, positions):
+            if pos.outcome() is not None:
+                responses.append(self._terminal_response(chunk, wp, pos, 0.001))
+                continue
+            legal = pos.legal_moves()
+            B = self._pad(max(len(legal), 1))
+            boards = [from_position(pos.push(m)) for m in legal]
+            roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+
+            ranked = []
+            depth_reached = 0
+            nodes_total = 0
+            for depth in range(1, target_depth + 1):
+                depth_arr = np.zeros(B, np.int32)
+                depth_arr[: len(legal)] = depth - 1
+                out = self._search(
+                    roots, depth_arr, np.full(B, 10_000_000, np.int32),
+                    hard_deadline if depth == 1 else soft_deadline,
+                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
+                )
+                if not bool(out["done"][: len(legal)].all()):
+                    break  # movetime/deadline hit: keep the previous depth
+                nodes_total += int(out["nodes"][: len(legal)].sum()) + len(legal)
+                ranked = sorted(
+                    ((-int(out["score"][j]), j) for j in range(len(legal))),
+                    key=lambda t: (-t[0], t[1]),
+                )
+                depth_reached = depth
+                if time.monotonic() >= soft_deadline:
+                    break
+            if depth_reached == 0:
+                raise EngineError("move job deadline expired before depth 1")
+
+            sf_skill = level.engine_skill_level  # -9..20
+            top = ranked[0][0]
+            if sf_skill >= 20 or len(ranked) == 1:
+                pick = ranked[0]
+            else:
+                # weakness window in cp, mirroring Stockfish's
+                # 120 - 2*skill shape; seeded per job for reproducibility
+                weakness = 120 - 2 * sf_skill
+                rng = random.Random(f"{work.id}:{wp.position_index}")
+                cands = [r for r in ranked if top - r[0] <= 3 * weakness]
+                weights = [math.exp(-(top - r[0]) / weakness) for r in cands]
+                pick = rng.choices(cands, weights=weights, k=1)[0]
+            best_move = legal[pick[1]].uci()
+
+            scores, pvs = Matrix(), Matrix()
+            scores.set(1, depth_reached, _score_from_int(pick[0]))
+            pvs.set(1, depth_reached, [best_move])
+            dt = max(time.monotonic() - started, 1e-6)
+            responses.append(
+                PositionResponse(
+                    work=chunk.work, position_index=wp.position_index,
+                    url=wp.url, scores=scores, pvs=pvs, best_move=best_move,
+                    depth=depth_reached, nodes=nodes_total, time_s=dt,
+                    nps=int(nodes_total / dt),
+                )
             )
         return responses
 
@@ -202,7 +335,7 @@ class TpuEngine:
         nodes_total = [0] * len(positions)
 
         if lanes:
-            B = _pad_lanes(len(lanes))
+            B = self._pad(len(lanes))
             boards = [from_position(positions[i]) for i in lanes]
             pad = from_position(positions[lanes[0]])
             roots = stack_boards(boards + [pad] * (B - len(boards)))
@@ -214,13 +347,10 @@ class TpuEngine:
                 depth_arr = np.zeros(B, np.int32)
                 depth_arr[: len(lanes)] = depth
                 budget_arr = np.clip(remaining, 0, 2**31 - 1).astype(np.int32)
-                out = search_batch_resumable(
-                    self.params, roots, jnp.asarray(depth_arr),
-                    jnp.asarray(budget_arr), max_ply=MAX_PLY,
-                    deadline=deadline, tt=self.tt,
+                out = self._search(
+                    roots, depth_arr, budget_arr, deadline,
+                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
                 )
-                self.tt = out.pop("tt")
-                out = {k: np.asarray(v) for k, v in out.items()}
                 exhausted_all = True
                 for j, i in enumerate(lanes):
                     if remaining[j] <= 0 or not bool(out["done"][j]):
@@ -271,80 +401,112 @@ class TpuEngine:
 
     def _analyse_multipv(self, chunk, positions, multipv, target_depth,
                          budget, started):
-        """MultiPV via root-move lanes: every legal root move of every
-        position becomes a lane searched at depth-1."""
-        responses = []
-        elapsed_base = time.monotonic()
-        for wp, pos in zip(chunk.positions, positions):
-            t0 = time.monotonic()
-            if pos.outcome() is not None:
-                responses.append(
-                    self._terminal_response(chunk, wp, pos, 0.001)
-                )
-                continue
-            legal = pos.legal_moves()
-            children = [pos.push(m) for m in legal]
-            # pad to ≥64 so warmup's precompiled bucket covers the common
-            # 20-40 legal-move case (>64 legal moves is rare; pays compile)
-            B = _pad_lanes(max(len(children), 64))
-            boards = [from_position(c) for c in children]
-            roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+        """MultiPV via root-move-partitioned lanes: every legal root move
+        of EVERY chunk position becomes a lane, all searched together in
+        one dispatch per iterative-deepening depth. This is where batching
+        beats the reference hardest — Stockfish pays ~multipv× for
+        MultiPV (reference: src/stockfish.rs:272 sets MultiPV and the
+        engine re-searches), while lanes are just lanes here."""
+        live = [i for i, p in enumerate(positions) if p.outcome() is None]
+        legal: dict[int, list] = {i: positions[i].legal_moves() for i in live}
+        # lane table: (position index, move index) per lane
+        lane_pos: List[int] = []
+        lane_move: List[int] = []
+        boards = []
+        for i in live:
+            for j, m in enumerate(legal[i]):
+                lane_pos.append(i)
+                lane_move.append(j)
+                boards.append(from_position(positions[i].push(m)))
 
-            scores, pvs = Matrix(), Matrix()
-            nodes_total = 0
-            depth_reached = 0
-            best_move = None
+        scores = [Matrix() for _ in positions]
+        pvs = [Matrix() for _ in positions]
+        depth_reached = [0] * len(positions)
+        best_moves: List[Optional[str]] = [None] * len(positions)
+        nodes_total = [0] * len(positions)
+
+        if boards:
+            B = self._pad(max(len(boards), 64))
+            roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             per_pos_budget = budget if budget is not None else 10_000_000
-            remaining = per_pos_budget
+            remaining = {i: per_pos_budget for i in live}
 
             deadline = chunk.deadline - 0.25
             for depth in range(1, target_depth + 1):
                 depth_arr = np.zeros(B, np.int32)
-                depth_arr[: len(children)] = depth - 1
-                share = max(remaining // max(len(children), 1), 1)
-                out = search_batch_resumable(
-                    self.params, roots,
-                    jnp.asarray(depth_arr),
-                    jnp.asarray(np.full(B, min(share, 2**31 - 1), np.int32)),
-                    max_ply=MAX_PLY,
-                    deadline=deadline, tt=self.tt,
+                budget_arr = np.ones(B, np.int32)
+                for k, i in enumerate(lane_pos):
+                    if remaining[i] > 0:
+                        depth_arr[k] = depth - 1
+                        budget_arr[k] = min(
+                            max(remaining[i] // max(len(legal[i]), 1), 1),
+                            2**31 - 1,
+                        )
+                out = self._search(
+                    roots, depth_arr, budget_arr, deadline,
+                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
                 )
-                self.tt = out.pop("tt")
-                out = {k: np.asarray(v) for k, v in out.items()}
-                if not bool(out["done"][: len(children)].all()):
-                    break  # deadline hit mid-depth: keep previous depth's lines
-                step_nodes = int(out["nodes"][: len(children)].sum()) + len(children)
-                nodes_total += step_nodes
-                remaining -= step_nodes
-                ranked = []
-                for j, m in enumerate(legal):
-                    child_score = -int(out["score"][j])
+                done = out["done"]
+                # fold lanes back per position
+                per_pos_done = {i: True for i in live}
+                for k, i in enumerate(lane_pos):
+                    if remaining[i] > 0 and not bool(done[k]):
+                        per_pos_done[i] = False
+                ranked: dict[int, list] = {i: [] for i in live}
+                for k, (i, j) in enumerate(zip(lane_pos, lane_move)):
+                    if remaining[i] <= 0 or not per_pos_done[i]:
+                        continue
+                    m = legal[i][j]
+                    child_score = -int(out["score"][k])
                     child_pv = [
                         _decode_uci(int(x))
-                        for x in out["pv"][j][: int(out["pv_len"][j])]
+                        for x in out["pv"][k][: int(out["pv_len"][k])]
                         if x >= 0
                     ]
-                    ranked.append((child_score, m.uci(), [m.uci()] + child_pv))
-                ranked.sort(key=lambda t: -t[0])
-                for rank, (sc, _mv, line) in enumerate(ranked[:multipv], start=1):
-                    scores.set(rank, depth, _score_from_int(sc))
-                    pvs.set(rank, depth, line)
-                depth_reached = depth
-                best_move = ranked[0][1]
-                if remaining <= 0 or time.monotonic() >= deadline:
+                    ranked[i].append((child_score, j, [m.uci()] + child_pv))
+                progressed = False
+                for i in live:
+                    if remaining[i] <= 0 or not per_pos_done[i] or not ranked[i]:
+                        continue
+                    step_nodes = sum(
+                        int(out["nodes"][k])
+                        for k, pi in enumerate(lane_pos)
+                        if pi == i
+                    ) + len(legal[i])
+                    nodes_total[i] += step_nodes
+                    remaining[i] -= step_nodes
+                    rl = sorted(ranked[i], key=lambda t: (-t[0], t[1]))
+                    for rank, (sc, _j, line) in enumerate(rl[:multipv], start=1):
+                        scores[i].set(rank, depth, _score_from_int(sc))
+                        pvs[i].set(rank, depth, line)
+                    depth_reached[i] = depth
+                    best_moves[i] = rl[0][2][0]
+                    if remaining[i] > 0:
+                        progressed = True
+                if not progressed or time.monotonic() >= deadline:
                     break
 
-            if depth_reached == 0:
-                raise EngineError(
-                    "chunk deadline expired before depth 1 completed (multipv)"
+        if any(depth_reached[i] == 0 for i in live):
+            raise EngineError(
+                "chunk deadline expired before depth 1 completed (multipv)"
+            )
+
+        elapsed = max(time.monotonic() - started, 1e-6)
+        per_pos_time = elapsed / max(len(positions), 1)
+        responses = []
+        for i, wp in enumerate(chunk.positions):
+            if i not in live:
+                responses.append(
+                    self._terminal_response(chunk, wp, positions[i], per_pos_time)
                 )
-            dt = max(time.monotonic() - t0, 1e-6)
+                continue
             responses.append(
                 PositionResponse(
                     work=chunk.work, position_index=wp.position_index,
-                    url=wp.url, scores=scores, pvs=pvs, best_move=best_move,
-                    depth=depth_reached, nodes=nodes_total, time_s=dt,
-                    nps=int(nodes_total / dt),
+                    url=wp.url, scores=scores[i], pvs=pvs[i],
+                    best_move=best_moves[i], depth=depth_reached[i],
+                    nodes=nodes_total[i], time_s=per_pos_time,
+                    nps=int(nodes_total[i] / per_pos_time),
                 )
             )
         return responses

@@ -165,3 +165,173 @@ def train_material_net(
             jnp.asarray(targets[idx]),
         )
     return params, float(loss)
+
+
+# ------------------------------------------ classical target + diverse data
+#
+# The packaged board768 net is distilled from a classical handcrafted
+# evaluation (material + piece-square + mobility), the same bootstrap real
+# NNUE lineages used before self-play data existed. The r1 net trained on
+# random-playout positions only — near-balanced material throughout — so it
+# extrapolated garbage on imbalanced/sparse positions (a bare
+# queen-vs-king board eval'd ~0). The dataset below mixes playouts with
+# synthetic random-material positions precisely to pin the material axis.
+
+_PST_PAWN = np.array([
+    0, 0, 0, 0, 0, 0, 0, 0,
+    5, 10, 10, -20, -20, 10, 10, 5,
+    5, -5, -10, 0, 0, -10, -5, 5,
+    0, 0, 0, 20, 20, 0, 0, 0,
+    5, 5, 10, 25, 25, 10, 5, 5,
+    10, 10, 20, 30, 30, 20, 10, 10,
+    50, 50, 50, 50, 50, 50, 50, 50,
+    0, 0, 0, 0, 0, 0, 0, 0,
+], np.int32)
+_PST_KNIGHT = np.array([
+    -50, -40, -30, -30, -30, -30, -40, -50,
+    -40, -20, 0, 5, 5, 0, -20, -40,
+    -30, 5, 10, 15, 15, 10, 5, -30,
+    -30, 0, 15, 20, 20, 15, 0, -30,
+    -30, 5, 15, 20, 20, 15, 5, -30,
+    -30, 0, 10, 15, 15, 10, 0, -30,
+    -40, -20, 0, 0, 0, 0, -20, -40,
+    -50, -40, -30, -30, -30, -30, -40, -50,
+], np.int32)
+_PST_BISHOP = np.array([
+    -20, -10, -10, -10, -10, -10, -10, -20,
+    -10, 5, 0, 0, 0, 0, 5, -10,
+    -10, 10, 10, 10, 10, 10, 10, -10,
+    -10, 0, 10, 10, 10, 10, 0, -10,
+    -10, 5, 5, 10, 10, 5, 5, -10,
+    -10, 0, 5, 10, 10, 5, 0, -10,
+    -10, 0, 0, 0, 0, 0, 0, -10,
+    -20, -10, -10, -10, -10, -10, -10, -20,
+], np.int32)
+_PST_ROOK = np.array([
+    0, 0, 0, 5, 5, 0, 0, 0,
+    -5, 0, 0, 0, 0, 0, 0, -5,
+    -5, 0, 0, 0, 0, 0, 0, -5,
+    -5, 0, 0, 0, 0, 0, 0, -5,
+    -5, 0, 0, 0, 0, 0, 0, -5,
+    -5, 0, 0, 0, 0, 0, 0, -5,
+    5, 10, 10, 10, 10, 10, 10, 5,
+    0, 0, 0, 0, 0, 0, 0, 0,
+], np.int32)
+_PST_QUEEN = np.array([
+    -20, -10, -10, -5, -5, -10, -10, -20,
+    -10, 0, 5, 0, 0, 0, 0, -10,
+    -10, 5, 5, 5, 5, 5, 0, -10,
+    0, 0, 5, 5, 5, 5, 0, -5,
+    -5, 0, 5, 5, 5, 5, 0, -5,
+    -10, 0, 5, 5, 5, 5, 0, -10,
+    -10, 0, 0, 0, 0, 0, 0, -10,
+    -20, -10, -10, -5, -5, -10, -10, -20,
+], np.int32)
+_PST_KING = np.array([
+    20, 30, 10, 0, 0, 10, 30, 20,
+    20, 20, 0, 0, 0, 0, 20, 20,
+    -10, -20, -20, -20, -20, -20, -20, -10,
+    -20, -30, -30, -40, -40, -30, -30, -20,
+    -30, -40, -40, -50, -50, -40, -40, -30,
+    -30, -40, -40, -50, -50, -40, -40, -30,
+    -30, -40, -40, -50, -50, -40, -40, -30,
+    -30, -40, -40, -50, -50, -40, -40, -30,
+], np.int32)
+_PSTS = [_PST_PAWN, _PST_KNIGHT, _PST_BISHOP, _PST_ROOK, _PST_QUEEN, _PST_KING]
+_PIECE_VALUES = [100, 300, 315, 500, 900, 0]
+
+
+def classical_eval_target(pos) -> float:
+    """Material + piece-square + mobility in cp from the side to move."""
+    from ..chess.types import scan
+
+    score = 0
+    for color in (0, 1):
+        sign = 1 if color == pos.turn else -1
+        for ptype in range(6):
+            for sq in scan(pos.bbs[color][ptype]):
+                o_sq = sq if color == 0 else sq ^ 56
+                score += sign * (_PIECE_VALUES[ptype] + int(_PSTS[ptype][o_sq]))
+    score += 2 * len(pos.legal_moves())
+    return float(np.clip(score, -3000, 3000))
+
+
+def _random_material_position(rng) -> Optional[object]:
+    """A synthetic legal-ish position with random (often lopsided)
+    material — the axis random playouts never cover."""
+    from ..chess import Position
+
+    board = [""] * 64
+    squares = list(range(64))
+    rng.shuffle(squares)
+    it = iter(squares)
+    wk, bk = next(it), next(it)
+    while max(abs((wk & 7) - (bk & 7)), abs((wk >> 3) - (bk >> 3))) <= 1:
+        bk = next(it)
+    board[wk], board[bk] = "K", "k"
+    for color, syms in ((0, "PNBRQ"), (1, "pnbrq")):
+        counts = [
+            rng.randint(0, 8), rng.randint(0, 2), rng.randint(0, 2),
+            rng.randint(0, 2), rng.randint(0, 1),
+        ]
+        for ptype, cnt in enumerate(counts):
+            for _ in range(cnt):
+                sq = next(it, None)
+                if sq is None:
+                    break
+                if syms[ptype] in "Pp" and (sq < 8 or sq >= 56):
+                    continue
+                board[sq] = syms[ptype]
+    rows = []
+    for rank in range(7, -1, -1):
+        row, empty = "", 0
+        for f in range(8):
+            c = board[rank * 8 + f]
+            if c:
+                row += (str(empty) if empty else "") + c
+                empty = 0
+            else:
+                empty += 1
+        rows.append(row + (str(empty) if empty else ""))
+    fen = "/".join(rows) + (" w - - 0 1" if rng.random() < 0.5 else " b - - 0 1")
+    try:
+        return Position.from_fen(fen)
+    except Exception:
+        return None
+
+
+def diverse_position_dataset(n: int, seed: int = 0):
+    """50% random-playout positions (structure), 50% synthetic
+    random-material positions (material axis); classical targets."""
+    import random as _random
+
+    from ..chess import Position
+    from ..ops.board import from_position
+
+    rng = _random.Random(seed)
+    boards = np.zeros((n, 64), np.int32)
+    stms = np.zeros((n,), np.int32)
+    targets = np.zeros((n,), np.float32)
+    pos = Position.initial()
+    plies = 0
+    i = 0
+    while i < n:
+        if i % 2 == 0:
+            legal = pos.legal_moves()
+            if not legal or plies > 80 or pos.outcome() is not None:
+                pos = Position.initial()
+                plies = 0
+                legal = pos.legal_moves()
+            pos = pos.push(rng.choice(legal))
+            plies += 1
+            sample = pos
+        else:
+            sample = _random_material_position(rng)
+            if sample is None or sample.outcome() is not None:
+                continue
+        b = from_position(sample)
+        boards[i] = np.asarray(b.board)
+        stms[i] = int(b.stm)
+        targets[i] = classical_eval_target(sample)
+        i += 1
+    return boards, stms, targets

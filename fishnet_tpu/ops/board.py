@@ -33,6 +33,17 @@ class Board(NamedTuple):
     ep: jnp.ndarray  # (...,) int32
     castling: jnp.ndarray  # (..., 4) int32
     halfmove: jnp.ndarray  # (...,) int32
+    # variant side-state, zeros for standard chess (EXTRA_* layout below):
+    # [0:2]   threeCheck: checks delivered by white, black
+    # [0:10]  crazyhouse: pocket counts [white P N B R Q, black P N B R Q]
+    # [10:12] crazyhouse: promoted-piece bitboard (low word, high word)
+    extra: jnp.ndarray  # (..., 12) int32
+
+
+EXTRA_W = 12
+EXTRA_CHECKS = 0  # +color
+EXTRA_POCKET = 0  # +color*5 + ptype
+EXTRA_PROMOTED = 10  # +word
 
 
 def from_position(pos: Position) -> Board:
@@ -52,12 +63,24 @@ def from_position(pos: Position) -> Board:
                 continue
             side = 0 if rsq > ksq else 1
             castling[color * 2 + side] = rsq
+    extra = np.zeros(EXTRA_W, dtype=np.int32)
+    if getattr(pos, "variant", "standard") == "threeCheck":
+        for color in (0, 1):
+            extra[EXTRA_CHECKS + color] = pos.checks_given[color]
+    elif getattr(pos, "variant", "standard") == "crazyhouse":
+        for color in (0, 1):
+            for ptype in range(5):
+                extra[EXTRA_POCKET + color * 5 + ptype] = pos.pockets[color][ptype]
+        for w in (0, 1):
+            word = (pos.promoted >> (32 * w)) & 0xFFFFFFFF
+            extra[EXTRA_PROMOTED + w] = word - (1 << 32) if word >= 1 << 31 else word
     return Board(
         board=jnp.asarray(board),
         stm=jnp.asarray(np.int32(pos.turn)),
         ep=jnp.asarray(np.int32(pos.ep_square if pos.ep_square is not None else -1)),
         castling=jnp.asarray(castling),
         halfmove=jnp.asarray(np.int32(pos.halfmove)),
+        extra=jnp.asarray(extra),
     )
 
 
@@ -127,16 +150,21 @@ def in_check(b: Board) -> jnp.ndarray:
     )
 
 
-def make_move(b: Board, move: jnp.ndarray) -> Board:
+def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
     """Apply an encoded move (from | to<<6 | promo<<12) to one lane.
 
     Castling is encoded king-takes-own-rook (matching the host library and
     UCI_Chess960 semantics); en passant and promotion are inferred from the
-    board, so no flag bits are needed.
+    board, so no flag bits are needed. `variant` is a STATIC flag: each
+    variant compiles its own program, keeping the standard path free of
+    variant branches (reference analog: Fairy-Stockfish's variant rules
+    behind `UCI_Variant`, src/stockfish.rs:245-260). Crazyhouse drops are
+    encoded as DROP_FLAG | ptype<<12 | to<<6 | to.
     """
     frm = move & 63
     to = (move >> 6) & 63
     promo = (move >> 12) & 7
+    is_drop = ((move >> 15) & 1) == 1 if variant == "crazyhouse" else None
 
     board = b.board
     piece = board[frm]
@@ -147,19 +175,29 @@ def make_move(b: Board, move: jnp.ndarray) -> Board:
     is_pawn = piece_type(piece) == 0
     is_king = piece_type(piece) == 5
     is_castle = is_king & (piece_color(target) == us) & (piece_type(target) == 3)
+    if is_drop is not None:
+        is_pawn &= ~is_drop
+        is_king &= ~is_drop
+        is_castle &= ~is_drop
 
     # en passant capture: pawn moves diagonally onto the empty ep square
     is_ep = is_pawn & (to == b.ep) & (target == 0) & ((to & 7) != (frm & 7))
     ep_victim = jnp.where(us == 0, to - 8, to + 8)
+    ep_victim_c = jnp.clip(ep_victim, 0, 63)
 
+    # (for drops frm == to and the square is empty, so clearing is a no-op)
     new_board = board.at[frm].set(0)
     new_board = jnp.where(
-        is_ep, new_board.at[jnp.clip(ep_victim, 0, 63)].set(0), new_board
+        is_ep, new_board.at[ep_victim_c].set(0), new_board
     )
 
     # normal placement (promotion replaces the pawn)
     promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
     placed = jnp.where(promo > 0, promo_piece, piece)
+    if is_drop is not None:
+        # dropped piece: promo bits carry the ptype (0..4 = P..Q)
+        drop_piece = 1 + jnp.clip(promo, 0, 4) + 6 * us
+        placed = jnp.where(is_drop, drop_piece, placed)
     normal_board = new_board.at[to].set(placed)
 
     # castling: clear rook square too, then place king on g/c and rook on f/d
@@ -177,14 +215,66 @@ def make_move(b: Board, move: jnp.ndarray) -> Board:
     cast = b.castling
     own_slots = jnp.arange(4) // 2 == us
     cast = jnp.where(is_king & own_slots, -1, cast)
-    cast = jnp.where((cast == frm) | (cast == to), -1, cast)
+    touched = (cast == frm) | (cast == to)
+    if is_drop is not None:
+        touched &= ~is_drop
+    cast = jnp.where(touched, -1, cast)
 
     # new ep square on double pawn push
     dbl = is_pawn & (jnp.abs(to - frm) == 16)
     new_ep = jnp.where(dbl, (frm + to) // 2, -1)
 
     capture = (piece_color(target) == them) | is_ep
-    new_halfmove = jnp.where(is_pawn | capture, 0, b.halfmove + 1)
+    pawnish = is_pawn
+    if is_drop is not None:
+        # a pawn drop is a pawn move (resets the fifty-move clock)
+        pawnish |= is_drop & (promo == 0)
+    new_halfmove = jnp.where(pawnish | capture, 0, b.halfmove + 1)
+
+    extra = b.extra
+    if variant == "threeCheck":
+        # did this move give check? (mover attacks the enemy king)
+        ek = king_square(out_board, them)
+        gave_check = (ek >= 0) & is_attacked(out_board, jnp.maximum(ek, 0), us)
+        extra = extra.at[EXTRA_CHECKS + us].add(
+            jnp.where(gave_check, 1, 0)
+        )
+    elif variant == "crazyhouse":
+        # promoted-piece bit transport: bit(sq) lives in extra[10 + sq//32]
+        def get_bit(e, sq):
+            return (e[EXTRA_PROMOTED + sq // 32] >> (sq % 32)) & 1
+
+        def with_bit(e, sq, val):
+            w = EXTRA_PROMOTED + sq // 32
+            bit = jnp.int32(1) << (sq % 32)
+            return e.at[w].set(
+                jnp.where(val == 1, e[w] | bit, e[w] & ~bit)
+            )
+
+        was_promoted_mover = get_bit(extra, frm) & jnp.where(is_drop, 0, 1)
+        cap_sq = jnp.where(is_ep, ep_victim_c, to)
+        victim_code = jnp.where(is_ep, board[ep_victim_c], target)
+        real_capture = capture & ~is_castle & ~is_drop
+        cap_promoted = get_bit(extra, cap_sq) & jnp.where(real_capture, 1, 0)
+        # pocket gains the captured piece, demoted to pawn if promoted
+        cap_type = jnp.where(
+            cap_promoted == 1, 0, jnp.maximum(piece_type(victim_code), 0)
+        )
+        pocket_slot = EXTRA_POCKET + us * 5 + jnp.clip(cap_type, 0, 4)
+        extra = extra.at[pocket_slot].add(jnp.where(real_capture, 1, 0))
+        # pocket pays for a drop
+        drop_slot = EXTRA_POCKET + us * 5 + jnp.clip(promo, 0, 4)
+        extra = extra.at[drop_slot].add(jnp.where(is_drop, -1, 0))
+        # bits: clear mover origin + capture square, then set destination
+        # when the arriving piece is promoted (fresh promotion or transport)
+        extra = with_bit(extra, frm, jnp.int32(0))
+        extra = with_bit(
+            extra, cap_sq, jnp.where(real_capture, 0, get_bit(extra, cap_sq))
+        )
+        dest_promoted = jnp.where(
+            is_drop, 0, jnp.where(promo > 0, 1, was_promoted_mover)
+        )
+        extra = with_bit(extra, to, dest_promoted)
 
     return Board(
         board=out_board,
@@ -192,20 +282,23 @@ def make_move(b: Board, move: jnp.ndarray) -> Board:
         ep=new_ep,
         castling=cast,
         halfmove=new_halfmove,
+        extra=extra,
     )
 
 
-def move_piece_changes(b: Board, move: jnp.ndarray):
+def move_piece_changes(b: Board, move: jnp.ndarray, variant: str = "standard"):
     """The ≤4 piece placements/removals a move causes, as fixed slots
     (codes (4,), squares (4,), signs (4,)); code 0 marks an unused slot.
 
     Feeds the incremental NNUE accumulator update (board768 path): castling
-    touches 4 slots (king out/in, rook out/in), captures/promotions ≤3.
+    touches 4 slots (king out/in, rook out/in), captures/promotions ≤3,
+    crazyhouse drops 1 (pockets are invisible to board features).
     Slot layout: [mover out, capture out, mover in, rook in(castle)].
     """
     frm = move & 63
     to = (move >> 6) & 63
     promo = (move >> 12) & 7
+    is_drop = ((move >> 15) & 1) == 1 if variant == "crazyhouse" else None
     board = b.board
     piece = board[frm]
     target = board[to]
@@ -214,11 +307,17 @@ def move_piece_changes(b: Board, move: jnp.ndarray):
     is_pawn = piece_type(piece) == 0
     is_king = piece_type(piece) == 5
     is_castle = is_king & (piece_color(target) == us) & (piece_type(target) == 3)
+    if is_drop is not None:
+        is_pawn &= ~is_drop
+        is_king &= ~is_drop
+        is_castle &= ~is_drop
     is_ep = is_pawn & (to == b.ep) & (target == 0) & ((to & 7) != (frm & 7))
     ep_victim = jnp.where(us == 0, to - 8, to + 8)
 
-    # slot 0: mover leaves frm
+    # slot 0: mover leaves frm (unused for drops: nothing leaves the board)
     c0, s0, g0 = piece, frm, jnp.int32(-1)
+    if is_drop is not None:
+        c0 = jnp.where(is_drop, 0, c0)
     # slot 1: captured piece leaves (normal capture, ep victim, or the
     # castling rook leaving its origin square)
     cap_code = jnp.where(
@@ -235,6 +334,8 @@ def move_piece_changes(b: Board, move: jnp.ndarray):
     k_dest = rank_base + jnp.where(kingside, 6, 2)
     promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
     placed = jnp.where(promo > 0, promo_piece, piece)
+    if is_drop is not None:
+        placed = jnp.where(is_drop, 1 + jnp.clip(promo, 0, 4) + 6 * us, placed)
     c2 = placed
     s2 = jnp.where(is_castle, k_dest, to)
     g2 = jnp.int32(1)
@@ -250,8 +351,9 @@ def move_piece_changes(b: Board, move: jnp.ndarray):
 
 
 # batched versions
-v_make_move = jax.vmap(make_move, in_axes=(Board(0, 0, 0, 0, 0), 0))
-v_in_check = jax.vmap(in_check, in_axes=(Board(0, 0, 0, 0, 0),))
+_B_AXES = Board(0, 0, 0, 0, 0, 0)
+v_make_move = jax.vmap(make_move, in_axes=(_B_AXES, 0))
+v_in_check = jax.vmap(in_check, in_axes=(_B_AXES,))
 
 
 def to_position_debug(b: Board) -> str:

@@ -18,27 +18,43 @@ import jax
 import jax.numpy as jnp
 
 from . import tables as T
-from .board import Board, is_attacked, king_square, piece_color, piece_type
+from .board import (
+    EXTRA_POCKET,
+    Board,
+    is_attacked,
+    king_square,
+    piece_color,
+    piece_type,
+)
 
 MAX_MOVES = T.MAX_MOVES
+# crazyhouse adds up to 5 droppable types × ≤62 empty squares on top of
+# ordinary board moves; its program compiles with a wider move list
+MAX_MOVES_ZH = 384
+DROP_FLAG = 1 << 15  # move encoding: drops are DROP_FLAG | pt<<12 | to<<6 | to
 
 
-def _compact(cands: jnp.ndarray, valid: jnp.ndarray, keys: jnp.ndarray):
-    """Scatter valid candidate moves into a dense (MAX_MOVES,) list.
+def max_moves_for(variant: str) -> int:
+    return MAX_MOVES_ZH if variant == "crazyhouse" else MAX_MOVES
+
+
+def _compact(cands: jnp.ndarray, valid: jnp.ndarray, keys: jnp.ndarray,
+             cap: int = MAX_MOVES):
+    """Scatter valid candidate moves into a dense (cap,) list.
 
     keys: smaller = earlier after the final sort (move ordering).
-    Returns (moves, keys, count); overflow beyond MAX_MOVES is dropped.
+    Returns (moves, keys, count); overflow beyond cap is dropped.
     """
     cands = cands.reshape(-1)
     valid = valid.reshape(-1)
     keys = keys.reshape(-1)
     pos = jnp.cumsum(valid) - valid.astype(jnp.int32)
-    idx = jnp.where(valid, pos, MAX_MOVES)  # out-of-range → dropped
-    moves = jnp.full((MAX_MOVES,), -1, dtype=jnp.int32)
-    out_keys = jnp.full((MAX_MOVES,), jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+    idx = jnp.where(valid, pos, cap)  # out-of-range → dropped
+    moves = jnp.full((cap,), -1, dtype=jnp.int32)
+    out_keys = jnp.full((cap,), jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
     moves = moves.at[idx].set(cands, mode="drop")
     out_keys = out_keys.at[idx].set(keys, mode="drop")
-    count = jnp.minimum(jnp.sum(valid), MAX_MOVES)
+    count = jnp.minimum(jnp.sum(valid), cap)
     return moves, out_keys, count
 
 
@@ -52,12 +68,15 @@ def _capture_key(victim_type: jnp.ndarray, attacker_type: jnp.ndarray,
     return key.astype(jnp.int32)
 
 
-def generate_moves(b: Board):
-    """→ (moves (MAX_MOVES,) sorted by ordering key, count (), noisy ()).
+def generate_moves(b: Board, variant: str = "standard"):
+    """→ (moves (max_moves_for(variant),) sorted by ordering key, count (),
+    noisy ()).
 
     noisy = how many leading moves are captures / queen promotions (they
     sort first) — the quiescence search expands only those.
     Moves are encoded from | to<<6 | promo<<12; castling is king-takes-rook.
+    `variant` is STATIC (compiled per variant): threeCheck generates like
+    standard; crazyhouse appends pocket drops (quiet, after board quiets).
     """
     board = b.board
     us = b.stm
@@ -230,10 +249,32 @@ def generate_moves(b: Board):
     all_valid.append(jnp.stack([ok0, ok1]))
     all_keys.append(jnp.full((2,), 900, dtype=jnp.int32))
 
+    # ------------------------------------------------------ crazyhouse drops
+    if variant == "crazyhouse":
+        pocket = jax.lax.dynamic_slice(
+            b.extra, (us * 5,), (5,)
+        )  # (5,) our P N B R Q counts
+        empty = board == 0  # (64,)
+        pt = jnp.arange(5, dtype=jnp.int32)
+        ranks8 = sq_idx >> 3
+        pawn_ok_sq = (ranks8 != 0) & (ranks8 != 7)
+        valid = (
+            (pocket > 0)[:, None]
+            & empty[None, :]
+            & jnp.where(pt[:, None] == 0, pawn_ok_sq[None, :], True)
+        )  # (5, 64)
+        cands = DROP_FLAG | (pt[:, None] << 12) | (sq_idx[None, :] << 6) | sq_idx[None, :]
+        all_moves.append(cands)
+        all_valid.append(valid)
+        # drops search after ordinary quiet moves
+        all_keys.append(jnp.full((5, 64), 1100, dtype=jnp.int32))
+
     flat_moves = jnp.concatenate([m.reshape(-1) for m in all_moves])
     flat_valid = jnp.concatenate([v.reshape(-1) for v in all_valid])
     flat_keys = jnp.concatenate([k.reshape(-1) for k in all_keys])
-    moves, keys, count = _compact(flat_moves, flat_valid, flat_keys)
+    moves, keys, count = _compact(
+        flat_moves, flat_valid, flat_keys, cap=max_moves_for(variant)
+    )
 
     # order: stable sort by key so captures/promotions are searched first
     order = jnp.argsort(keys, stable=True)
@@ -242,4 +283,4 @@ def generate_moves(b: Board):
     return moves[order], count, noisy
 
 
-v_generate_moves = jax.vmap(generate_moves, in_axes=(Board(0, 0, 0, 0, 0),))
+v_generate_moves = jax.vmap(generate_moves, in_axes=(Board(0, 0, 0, 0, 0, 0),))

@@ -1,0 +1,181 @@
+"""Host-side model of the device search, for exact-equality testing.
+
+The reference's search correctness is carried by Stockfish itself; the
+lockstep device search (ops/search.py) needs an oracle instead. This is a
+plain recursive negamax that mirrors the device state machine EXACTLY —
+same pseudo-legal movegen and move order, same king-capture refutation,
+same capture-only quiescence with stand-pat floor, same fifty-move /
+repetition / budget / stack-full leaf rules, same mate/stalemate values,
+and the same NNUE evaluation path (incremental board768 accumulators or
+full refresh) — so `search_batch` results can be asserted bit-identical
+at small depth.
+
+It deliberately calls the device ops (fused into two jitted calls per
+node, dispatched from the recursion) rather than re-implementing them in
+numpy: float summation order then matches the device program exactly,
+keeping int-cast evals bit-stable.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import nnue
+from . import tt as tt_mod
+from .board import (
+    EXTRA_CHECKS,
+    Board,
+    is_attacked,
+    king_square,
+    make_move,
+    move_piece_changes,
+)
+from .movegen import generate_moves
+from .search import DRAW, ILLEGAL, INF, MATE
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted(b768: bool, variant: str):
+    """Two fused device calls per oracle node (single-core dispatch cost
+    dominates the oracle's runtime, so everything per-node is batched into
+    `classify`, and per-child into `child`)."""
+
+    def classify(params, b: Board, acc):
+        us = b.stm
+        them = 1 - us
+        their_k = king_square(b.board, them)
+        illegal = (their_k < 0) | is_attacked(
+            b.board, jnp.maximum(their_k, 0), us
+        )
+        our_k = king_square(b.board, us)
+        checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
+        if b768:
+            val = jnp.int32(
+                nnue.forward_from_acc(params, acc, us, nnue.output_bucket(b.board))
+            )
+        else:
+            val = jnp.int32(nnue.evaluate(params, b.board, us))
+        moves, count, noisy = generate_moves(b, variant)
+        h1, h2 = tt_mod.hash_board(b.board, us, b.ep, b.castling, b.extra, variant)
+        them_checks = jnp.where(
+            us == 0, b.extra[EXTRA_CHECKS + 1], b.extra[EXTRA_CHECKS + 0]
+        )
+        return illegal, checked, val, moves, count, noisy, h1, h2, them_checks
+
+    def child(params, b: Board, acc, move):
+        nb = make_move(b, move, variant)
+        if b768:
+            codes, sqs, signs = move_piece_changes(b, move, variant)
+            nacc = nnue.apply_acc_updates_768(params, acc, codes, sqs, signs)
+        else:
+            nacc = acc
+        return nb, nacc
+
+    return {
+        "classify": jax.jit(classify),
+        "child": jax.jit(child),
+        "acc_root": jax.jit(nnue.accumulators_768),
+    }
+
+
+class _Oracle:
+    def __init__(self, params, depth: int, node_budget: int, max_ply: int,
+                 variant: str = "standard"):
+        self.p = params
+        self.depth = depth
+        self.budget = node_budget
+        self.max_ply = max_ply
+        self.variant = variant
+        self.nodes = 0
+        self.rep_hits = 0  # repetition-draw leaves seen (test instrumentation)
+        self.b768 = nnue.is_board768(params)
+        self.ops = _jitted(self.b768, variant)
+        self.path = []  # [(h1, h2, halfmove)] of entered path nodes
+
+    def search(self, b: Board, acc, ply: int, alpha: int, beta: int) -> int:
+        ops = self.ops
+        (illegal, checked, val, moves, count, noisy, h1, h2,
+         them_checks) = ops["classify"](self.p, b, acc)
+        if ply > 0 and bool(illegal):
+            return ILLEGAL
+        depth_left = self.depth - ply
+        over_budget = self.nodes >= self.budget
+        self.nodes += 1
+        halfmove = int(b.halfmove)
+        fifty = halfmove >= 100
+        # twofold repetition along the path (mirrors ops/search.py):
+        # equal hash through an unbroken reversible chain
+        hh = (int(h1), int(h2))
+        repet = any(
+            (halfmove - ph) == (ply - k) and (a, c) == hh
+            for k, (a, c, ph) in enumerate(self.path)
+        )
+        self.rep_hits += int(repet)
+        in_qs = depth_left <= 0
+        stack_full = ply >= self.max_ply
+
+        leaf_val = DRAW if (fifty or repet) else max(
+            min(int(val), MATE - 1000), -(MATE - 1000)
+        )
+        three = self.variant == "threeCheck" and int(them_checks) >= 3
+        if three:
+            leaf_val = -(MATE - ply)
+        count, noisy = int(count), int(noisy)
+        is_leaf = (
+            fifty or repet or three or over_budget or stack_full
+            or (in_qs and noisy == 0)
+        )
+        if in_qs and leaf_val >= beta:  # stand-pat beta cutoff
+            is_leaf = True
+        if is_leaf:
+            return leaf_val
+
+        n = noisy if in_qs else count
+        moves = np.asarray(moves)
+        if in_qs:
+            best = leaf_val  # stand-pat floors best and alpha
+            alpha = max(alpha, leaf_val)
+        else:
+            best = -INF
+        searched = 0
+        cut = False
+        self.path.append((hh[0], hh[1], halfmove))
+        try:
+            for i in range(n):
+                if alpha >= beta:
+                    cut = True
+                    break
+                mv = int(moves[i])
+                cb, cacc = ops["child"](self.p, b, acc, jnp.int32(mv))
+                v = self.search(cb, cacc, ply + 1, -beta, -alpha)
+                if v == ILLEGAL:
+                    continue
+                searched += 1
+                if -v > best:
+                    best = -v
+                alpha = max(alpha, best)
+        finally:
+            self.path.pop()
+        if searched == 0 and not in_qs and not cut:
+            return -(MATE - ply) if bool(checked) else DRAW
+        return best
+
+
+def oracle_search(params, root: Board, depth: int, node_budget: int,
+                  max_ply: int, variant: str = "standard") -> dict:
+    """Search one root exactly like one device lane; → {score, nodes}.
+
+    root: single-lane Board. Matches ops.search.search_batch semantics for
+    the same (depth, node_budget, max_ply, variant); scores must agree
+    exactly.
+    """
+    o = _Oracle(params, depth, node_budget, max_ply, variant)
+    if o.b768:
+        acc = o.ops["acc_root"](params, root.board)
+    else:
+        acc = jnp.zeros((2, params.ft_w.shape[1]), params.ft_w.dtype)
+    score = o.search(root, acc, 0, -INF, INF)
+    return {"score": score, "nodes": o.nodes, "rep_hits": o.rep_hits}

@@ -27,16 +27,18 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models import nnue
 from .board import (
+    EXTRA_CHECKS,
     Board,
     is_attacked,
     king_square,
     make_move,
     move_piece_changes,
 )
-from .movegen import MAX_MOVES, generate_moves
+from .movegen import MAX_MOVES, generate_moves, max_moves_for
 
 INF = 32500
 MATE = 32000
@@ -56,6 +58,8 @@ class SearchState(NamedTuple):
     ep: jnp.ndarray  # (B, P+1)
     castling: jnp.ndarray  # (B, P+1, 4)
     halfmove: jnp.ndarray  # (B, P+1)
+    extra: jnp.ndarray  # (B, P+1, 12) variant side-state (board.EXTRA_*)
+    phash: jnp.ndarray  # (B, P+1, 2) uint32 path hashes (repetition scan)
     moves: jnp.ndarray  # (B, P, MAX_MOVES) int32
     count: jnp.ndarray  # (B, P)
     midx: jnp.ndarray  # (B, P)
@@ -92,11 +96,13 @@ def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
         ep=s.ep[ply],
         castling=s.castling[ply],
         halfmove=s.halfmove[ply],
+        extra=s.extra[ply],
     )
 
 
 def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
-               node_budget: jnp.ndarray, max_ply: int) -> SearchState:
+               node_budget: jnp.ndarray, max_ply: int,
+               variant: str = "standard") -> SearchState:
     """roots: batched Board (B leading dim); depth/node_budget: (B,)."""
     B = roots.stm.shape[0]
     P = max_ply
@@ -123,9 +129,13 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
     castling = castling.at[:, 0].set(roots.castling)
     halfmove = z(P + 1)
     halfmove = halfmove.at[:, 0].set(roots.halfmove)
+    extra = z(P + 1, 12)
+    extra = extra.at[:, 0].set(roots.extra)
+    phash = jnp.zeros((B, P + 1, 2), jnp.uint32)
     return SearchState(
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
-        moves=z(P, MAX_MOVES, fill=-1),
+        extra=extra, phash=phash,
+        moves=z(P, max_moves_for(variant), fill=-1),
         count=z(P), midx=z(P), searched=z(P),
         alpha=z(P, fill=-INF), alpha0=z(P, fill=-INF), beta=z(P, fill=INF),
         best=z(P, fill=-INF), best_move=z(P, fill=-1),
@@ -142,7 +152,8 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
 
 
 def _step_lane(params: nnue.NnueParams, s: SearchState,
-               tt_hit=None, tt_score=None, tt_move=None) -> SearchState:
+               tt_hit=None, tt_score=None, tt_move=None,
+               variant: str = "standard") -> SearchState:
     """One state-machine step for a single lane (vmapped over B).
 
     Every stack mutation is a masked *row-level* update (`at[ply].set` with
@@ -171,6 +182,31 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     depth_left = s.depth_limit - ply
     over_budget = s.nodes >= s.node_budget
     fifty = b.halfmove >= 100
+
+    # twofold repetition along the search path (reference behavior is
+    # Stockfish's draw scoring, observable through src/stockfish.rs score
+    # output): hash the position on entry, scan ancestors for an equal
+    # hash reachable through an unbroken reversible-move chain
+    # (halfmove[ply]-halfmove[k] == ply-k). Path-dependent by nature, so
+    # repetition draws are never TT-stored and never TT-overridden; the
+    # residual graph-history interaction is the same approximation every
+    # real engine ships.
+    from . import tt as _tt_mod
+
+    h1, h2 = _tt_mod.hash_board(
+        b.board, us, b.ep, b.castling, b.extra, variant
+    )
+    phash = s.phash.at[ply].set(
+        jnp.where(enter, jnp.stack([h1, h2]), s.phash[ply])
+    )
+    ks = jnp.arange(s.phash.shape[0], dtype=jnp.int32)
+    chain_ok = (b.halfmove - s.halfmove[ks]) == (ply - ks)
+    repet = enter & jnp.any(
+        (ks < ply)
+        & chain_ok
+        & (s.phash[:, 0] == h1)
+        & (s.phash[:, 1] == h2)
+    )
     # quiescence: past the nominal depth, keep expanding CAPTURES until
     # the position is quiet (gen_noisy == 0), the stack is full, or the
     # budget runs out — the standard horizon-effect fix, with stand-pat
@@ -189,11 +225,22 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     else:
         leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
     leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
-    leaf_val = jnp.where(fifty, DRAW, leaf_val)
+    leaf_val = jnp.where(fifty | repet, DRAW, leaf_val)
 
-    gen_moves, gen_count, gen_noisy = generate_moves(b)
+    # threeCheck: the opponent completing 3 checks ends the game at once
+    # (takes precedence over draws; mate-range value, so never TT-stored)
+    three = jnp.bool_(False)
+    if variant == "threeCheck":
+        them_checks = jnp.where(
+            us == 0, b.extra[EXTRA_CHECKS + 1], b.extra[EXTRA_CHECKS + 0]
+        )
+        three = them_checks >= 3
+        leaf_val = jnp.where(three, -(MATE - ply), leaf_val)
+
+    gen_moves, gen_count, gen_noisy = generate_moves(b, variant)
     is_leaf = (
-        fifty | over_budget | stack_full | (in_qs & (gen_noisy == 0))
+        fifty | repet | three | over_budget | stack_full
+        | (in_qs & (gen_noisy == 0))
     )
     # stand-pat beta cutoff: in QS the static eval is already >= beta —
     # the opponent wouldn't enter this line; fail high immediately
@@ -203,11 +250,13 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     is_leaf |= stand_pat_cut
 
     # TT cutoff: treat as a leaf return with the stored score (never at
-    # the root — the root must produce a move; never on fifty-move draws —
-    # the hash excludes the halfmove counter, so a stored score from a
-    # lower halfmove count must not override the forced draw)
+    # the root — the root must produce a move; never on fifty-move or
+    # repetition draws — the hash excludes the halfmove counter and the
+    # path, so a stored score must not override a forced draw)
     use_tt = (
-        (tt_hit & (ply > 0) & ~fifty) if tt_hit is not None else jnp.bool_(False)
+        (tt_hit & (ply > 0) & ~fifty & ~repet & ~three)
+        if tt_hit is not None
+        else jnp.bool_(False)
     )
     to_return = parent_illegal | is_leaf | use_tt
     expand = enter & ~to_return
@@ -217,7 +266,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # would later short-circuit a real QS expansion of the same position.
     # (fifty draws excluded: they don't transpose)
     leaf_store = (
-        enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty
+        enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty & ~repet
         & (gen_noisy == 0)
     )
     store_mark = leaf_store
@@ -341,12 +390,13 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
     fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
 
-    move = moves[ply, jnp.minimum(midx[ply], MAX_MOVES - 1)]
+    move = moves[ply, jnp.minimum(midx[ply], moves.shape[-1] - 1)]
     parent_b = Board(
         board=s.board[ply], stm=s.stm[ply], ep=s.ep[ply],
         castling=s.castling[ply], halfmove=s.halfmove[ply],
+        extra=s.extra[ply],
     )
-    child = make_move(parent_b, jnp.maximum(move, 0))
+    child = make_move(parent_b, jnp.maximum(move, 0), variant)
     nply = jnp.minimum(ply + 1, s.board.shape[0] - 1)
 
     midx = midx.at[ply].add(jnp.where(advance, 1, 0))
@@ -359,8 +409,13 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     halfmove = s.halfmove.at[nply].set(
         jnp.where(advance, child.halfmove, s.halfmove[nply])
     )
+    extra_st = s.extra.at[nply].set(
+        jnp.where(advance, child.extra, s.extra[nply])
+    )
     if nnue.is_board768(params):
-        codes, sqs, signs = move_piece_changes(parent_b, jnp.maximum(move, 0))
+        codes, sqs, signs = move_piece_changes(
+            parent_b, jnp.maximum(move, 0), variant
+        )
         child_acc = nnue.apply_acc_updates_768(params, s.acc[ply], codes, sqs, signs)
         acc = s.acc.at[nply].set(jnp.where(advance, child_acc, s.acc[nply]))
     else:
@@ -377,6 +432,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
 
     return SearchState(
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
+        extra=extra_st, phash=phash,
         moves=moves, count=count, midx=midx, searched=searched,
         alpha=alpha, alpha0=alpha0, beta=beta, best=best, best_move=best_move,
         incheck=incheck, pv=pv, pv_len=pv_len, acc=acc,
@@ -387,19 +443,21 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     )
 
 
-def make_search_step(params: nnue.NnueParams):
-    lane_axes = SearchState(
-        *[0 for _ in SearchState._fields]
-    )
-    return jax.vmap(lambda s: _step_lane(params, s), in_axes=(lane_axes,))
-
-
-def make_search_step_tt(params: nnue.NnueParams):
+def make_search_step(params: nnue.NnueParams, variant: str = "standard"):
     lane_axes = SearchState(
         *[0 for _ in SearchState._fields]
     )
     return jax.vmap(
-        lambda s, h, sc, m: _step_lane(params, s, h, sc, m),
+        lambda s: _step_lane(params, s, variant=variant), in_axes=(lane_axes,)
+    )
+
+
+def make_search_step_tt(params: nnue.NnueParams, variant: str = "standard"):
+    lane_axes = SearchState(
+        *[0 for _ in SearchState._fields]
+    )
+    return jax.vmap(
+        lambda s, h, sc, m: _step_lane(params, s, h, sc, m, variant=variant),
         in_axes=(lane_axes, 0, 0, 0),
     )
 
@@ -423,7 +481,7 @@ def _gather_ply(arr: jnp.ndarray, ply: jnp.ndarray) -> jnp.ndarray:
 
 
 def _run_segment(params: nnue.NnueParams, state: SearchState,
-                 ttab, segment_steps: int):
+                 ttab, segment_steps: int, variant: str = "standard"):
     """Advance all lanes ≤ segment_steps. ttab: shared tt.TTable or None.
 
     The TT lives OUTSIDE the vmap: each iteration first stores every lane
@@ -435,13 +493,13 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
     from . import tt as tt_mod
 
     if ttab is None:
-        step = make_search_step(params)
+        step = make_search_step(params, variant)
 
         def body(carry):
             s, t, i = carry
             return step(s), t, i + 1
     else:
-        step = make_search_step_tt(params)
+        step = make_search_step_tt(params, variant)
 
         def body(carry):
             s, t, i = carry
@@ -449,7 +507,12 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             st = _gather_ply(s.stm, s.ply)
             epv = _gather_ply(s.ep, s.ply)
             ca = _gather_ply(s.castling, s.ply)
-            h1, h2 = jax.vmap(tt_mod.hash_board)(bb, st, epv, ca)
+            ex = _gather_ply(s.extra, s.ply)
+            h1, h2 = jax.vmap(
+                lambda b_, s_, e_, c_, x_: tt_mod.hash_board(
+                    b_, s_, e_, c_, x_, variant
+                )
+            )(bb, st, epv, ca, ex)
 
             # ---- store lanes whose INTERIOR node just finished. (Leaf
             # returns fold into the parent within one step — the ENTER→
@@ -511,8 +574,10 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
     return state, ttab, n
 
 
-_run_segment_jit = jax.jit(_run_segment, static_argnames=("segment_steps",))
-_init_state_jit = jax.jit(init_state, static_argnames=("max_ply",))
+_run_segment_jit = jax.jit(
+    _run_segment, static_argnames=("segment_steps", "variant")
+)
+_init_state_jit = jax.jit(init_state, static_argnames=("max_ply", "variant"))
 
 
 def extract_results(state: SearchState, steps) -> dict:
@@ -537,6 +602,8 @@ def search_batch_resumable(
     max_steps: int = 4_000_000,
     deadline: float | None = None,
     tt=None,
+    mesh=None,
+    variant: str = "standard",
 ):
     """Like `search_batch`, but dispatched in bounded segments.
 
@@ -547,20 +614,42 @@ def search_batch_resumable(
     tt: optional shared ops.tt.TTable; the updated table is returned as
     results["tt"] so callers can carry it across searches (the engine
     keeps one per process, like Stockfish's persistent hash).
+
+    mesh: optional jax.sharding.Mesh — lanes shard over its devices and
+    each device advances its shard independently (parallel.mesh). With a
+    mesh, tt must carry a leading (ndev,) shard dim
+    (parallel.mesh.make_sharded_table) or be None.
     """
     import time as _time
 
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    state = _init_state_jit(params, roots, depth, node_budget, max_ply)
+    state = _init_state_jit(params, roots, depth, node_budget, max_ply, variant)
+    if mesh is not None:
+        from ..parallel.mesh import run_segment_sharded
+
+        def dispatch(state, tt):
+            state, tt, n = run_segment_sharded(
+                mesh, params, state, tt, segment_steps, variant=variant
+            )
+            # devices stop independently; continue while ANY used the
+            # full segment (i.e. may still have live lanes)
+            return state, tt, int(np.max(np.asarray(n)))
+    else:
+        def dispatch(state, tt):
+            state, tt, n = _run_segment_jit(
+                params, state, tt, segment_steps, variant
+            )
+            return state, tt, int(n)
+
     total = 0
     while total < max_steps:
         if deadline is not None and _time.monotonic() >= deadline:
             break  # don't dispatch (or cold-compile) a segment we'd discard
-        state, tt, n = _run_segment_jit(params, state, tt, segment_steps)
-        total += int(n)  # sync point: segment finished on device
-        if int(n) < segment_steps:
+        state, tt, n = dispatch(state, tt)
+        total += n  # sync point: segment finished on device
+        if n < segment_steps:
             break  # every lane parked in DONE
         if deadline is not None and _time.monotonic() >= deadline:
             break
@@ -570,7 +659,8 @@ def search_batch_resumable(
 
 
 def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
-                 max_ply: int, max_steps: int = 2_000_000, tt=None):
+                 max_ply: int, max_steps: int = 2_000_000, tt=None,
+                 variant: str = "standard"):
     """Run fixed-depth alpha-beta + capture quiescence on B roots in
     lockstep.
 
@@ -584,11 +674,13 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    state = init_state(params, roots, depth, node_budget, max_ply)
-    state, tt, steps = _run_segment(params, state, tt, max_steps)
+    state = init_state(params, roots, depth, node_budget, max_ply, variant)
+    state, tt, steps = _run_segment(params, state, tt, max_steps, variant)
     out = extract_results(state, steps)
     out["tt"] = tt
     return out
 
 
-search_batch_jit = jax.jit(search_batch, static_argnames=("max_ply", "max_steps"))
+search_batch_jit = jax.jit(
+    search_batch, static_argnames=("max_ply", "max_steps", "variant")
+)

@@ -38,14 +38,18 @@ _DEPTH_MASK = 0xFF
 _MAX_STORE = 30000  # skip mate-range scores (|MATE|-1000 = 31000 > this)
 
 # two independent 32-bit zobrist tables from one seeded PRNG; host-side
-# constants baked into the program
+# constants baked into the program. Layout: piece-square | ep | castling |
+# stm | variant extras (pocket counts, check counters, promoted bits)
 _rng = np.random.default_rng(0xF15F_4E7)
-_Z_SHAPE = 13 * 64 + 65 + 4 * 65 + 2  # piece-square | ep | castling | stm
-Z1 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
-Z2 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
 _EP_OFF = 13 * 64
 _CASTLE_OFF = _EP_OFF + 65
 _STM_OFF = _CASTLE_OFF + 4 * 65
+_POCKET_OFF = _STM_OFF + 2  # 10 slots × counts 0..16
+_CHECKS_OFF = _POCKET_OFF + 10 * 17  # 2 colors × 0..3 checks
+_PROMOTED_OFF = _CHECKS_OFF + 2 * 4  # 64 promoted-square bits
+_Z_SHAPE = _PROMOTED_OFF + 64
+Z1 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
+Z2 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
 
 
 class TTable(NamedTuple):
@@ -68,12 +72,14 @@ def make_table(size_log2: int = 20) -> TTable:
     )
 
 
-def hash_board(board64, stm, ep, castling):
+def hash_board(board64, stm, ep, castling, extra=None, variant: str = "standard"):
     """→ (h1, h2) uint32 pair for one position; batched via vmap/broadcast.
 
     board64 (…,64) int32 codes 0..12; ep scalar -1..63; castling (…,4)
     rook squares or -1; stm 0|1. halfmove is deliberately excluded
-    (standard engine practice: 50-move distance doesn't transpose)."""
+    (standard engine practice: 50-move distance doesn't transpose).
+    `variant` (STATIC) folds Board.extra in: crazyhouse pockets + promoted
+    bits, threeCheck counters — standard hashes are unchanged."""
     sq = jnp.arange(64, dtype=jnp.int32)
     idx = board64 * 64 + sq  # code 0 → slots 0..63, masked below
     mask = board64 > 0
@@ -87,6 +93,20 @@ def hash_board(board64, stm, ep, castling):
         for i in range(4):
             h ^= z[_CASTLE_OFF + i * 65 + castling[..., i] + 1]
         h ^= z[_STM_OFF + stm]
+        if variant == "threeCheck":
+            for c in (0, 1):
+                h ^= z[_CHECKS_OFF + c * 4 + jnp.clip(extra[..., c], 0, 3)]
+        elif variant == "crazyhouse":
+            for slot in range(10):
+                h ^= z[_POCKET_OFF + slot * 17 + jnp.clip(extra[..., slot], 0, 16)]
+            words = extra[..., 10:12]
+            bits = (
+                jnp.right_shift(words[..., sq // 32], sq % 32) & 1
+            ) == 1
+            prows = jnp.where(bits, z[_PROMOTED_OFF + sq], 0)
+            h ^= jax.lax.reduce(
+                prows, jnp.uint32(0), jax.lax.bitwise_xor, (prows.ndim - 1,)
+            )
         return h
 
     return fold(Z1), fold(Z2)
@@ -116,7 +136,16 @@ def probe(tt: TTable, h1, h2, depth_left, alpha, beta):
     valid = (tt.check[slot] ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)) == h2
     valid &= meta != 0
     score, depth, flag = unpack_meta(meta)
-    deep_enough = depth >= depth_left
+    # EXACT depth match, not >=: an entry stored at depth d is a bound on
+    # the depth-d value of the node. The search's value at remaining depth
+    # d' < d is a DIFFERENT number (quiescence truncates differently), and
+    # a deeper bound does not bound it — substituting deeper values is what
+    # made TT-enabled root scores drift from the plain search. With exact
+    # matching every cutoff is a true bound on the same-depth value, so the
+    # root score is bit-identical with or without the table (determinism is
+    # a feature for analysis: same job → same output regardless of batch
+    # composition). Deeper entries still help via the ordering move.
+    deep_enough = depth == jnp.maximum(depth_left, 0)
     cuts = jnp.where(
         flag == FLAG_EXACT,
         True,

@@ -9,6 +9,7 @@ appear in training (psum of grads over dp, all_gather over tp).
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -45,27 +46,83 @@ def replicate(mesh: Mesh, tree):
     return jax.tree_util.tree_map(put, tree)
 
 
-def sharded_search(params, roots, depth, node_budget, max_ply: int,
-                   mesh: Optional[Mesh] = None):
-    """Run the batched search with lanes sharded across the mesh.
+@functools.lru_cache(maxsize=None)
+def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
+                      variant: str = "standard"):
+    """shard_map'd search segment: each device advances ITS lanes with ITS
+    transposition-table shard, fully locally — no collectives, and a device
+    whose lanes all park in DONE exits its while_loop early instead of
+    spinning in lockstep with slower devices. This is the TPU-native
+    equivalent of the reference's independent engine processes per core
+    (reference: src/main.rs:151-161)."""
+    from ..ops.search import _run_segment
 
-    The search program is identical to the single-chip one; XLA partitions
-    the lane dimension and runs each shard locally — no collectives are
-    needed until results are gathered back to host.
-    """
-    from ..ops.search import search_batch_jit
+    def seg(params, state, ttab):
+        if ttab is not None:
+            ttab = jax.tree.map(lambda a: a[0], ttab)  # (1, N) block → (N,)
+        state, ttab, n = _run_segment(
+            params, state, ttab, segment_steps, variant
+        )
+        if ttab is not None:
+            ttab = jax.tree.map(lambda a: a[None], ttab)
+        return state, ttab, n.reshape(1)
 
-    mesh = mesh or make_mesh()
+    fn = jax.shard_map(
+        seg,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis) if has_tt else P()),
+        out_specs=(P(axis), P(axis) if has_tt else P(), P(axis)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
+                        axis: str = "dp", variant: str = "standard"):
+    """Advance a sharded search ≤ segment_steps on every device.
+
+    state: SearchState with lane dim divisible by mesh size. ttab: TTable
+    whose arrays carry a leading (n_devices,) shard dim (see
+    make_sharded_table), or None. Returns (state, ttab, steps (ndev,))."""
+    fn = _segment_callable(mesh, axis, segment_steps, ttab is not None, variant)
+    return fn(params, state, ttab)
+
+
+def make_sharded_table(mesh: Mesh, size_log2: int):
+    """Per-device TT shards as one (ndev, N) array pair, placed sharded.
+
+    Each device hashes into its private shard (ops/tt.py masks by the
+    LOCAL size under shard_map) — cross-lane sharing happens within a
+    device's lanes, which is where the lockstep phase offsets are anyway."""
+    from ..ops import tt as tt_mod
+
+    n = mesh.devices.size
+    base = tt_mod.make_table(size_log2)
     import jax.numpy as jnp
 
+    t = tt_mod.TTable(
+        check=jnp.zeros((n, base.size), jnp.uint32),
+        meta=jnp.zeros((n, base.size), jnp.int32),
+        move=jnp.zeros((n, base.size), jnp.int32),
+    )
+    return shard_batch(mesh, t)
+
+
+def sharded_search(params, roots, depth, node_budget, max_ply: int,
+                   mesh: Optional[Mesh] = None, tt=None, **kw):
+    """Run the batched search with lanes sharded across the mesh.
+
+    Thin wrapper over ops.search.search_batch_resumable(mesh=...) — the
+    same code path the production TpuEngine uses (segments, deadline and
+    the shared table all work sharded)."""
+    from ..ops.search import search_batch_resumable
+
+    mesh = mesh or make_mesh()
     B = int(roots.stm.shape[0])
     n = mesh.devices.size
     if B % n != 0:
         raise ValueError(f"lane count {B} must divide over {n} devices")
-    depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
-    node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    roots = shard_batch(mesh, roots)
-    depth = shard_batch(mesh, depth)
-    node_budget = shard_batch(mesh, node_budget)
-    params = replicate(mesh, params)
-    return search_batch_jit(params, roots, depth, node_budget, max_ply=max_ply)
+    return search_batch_resumable(
+        params, roots, depth, node_budget, max_ply=max_ply, mesh=mesh,
+        tt=tt, **kw
+    )

@@ -16,7 +16,8 @@ from fake_server import FakeLichess
 START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 
 
-def run_client_until(server, condition, n_workers=2, timeout=60.0, tpu_variants=None):
+def run_client_until(server, condition, n_workers=2, timeout=60.0,
+                     tpu_variants=None, tpu_moves=False, factory=None):
     """Run queue+workers until condition(server) or timeout; returns queue."""
 
     async def main():
@@ -28,10 +29,11 @@ def run_client_until(server, condition, n_workers=2, timeout=60.0, tpu_variants=
             stats=StatsRecorder(no_stats_file=True, cores=n_workers),
             logger=Logger(verbose=0),
             tpu_variants=tpu_variants,
+            tpu_moves=tpu_moves,
         )
-        factory = lambda flavor: PyEngine(max_depth=2)
+        fct = factory or (lambda flavor: PyEngine(max_depth=2))
         tasks = [
-            asyncio.create_task(worker(i, queue, factory)) for i in range(n_workers)
+            asyncio.create_task(worker(i, queue, fct)) for i in range(n_workers)
         ]
         deadline = asyncio.get_running_loop().time() + timeout
         while not condition(server):
@@ -146,3 +148,33 @@ def test_abort_on_shutdown(server):
 
     asyncio.run(main())
     assert "job00006" in server.aborted
+
+
+def test_move_job_on_tpu_flavor(server):
+    """Play jobs ride the TPU engine when tpu_moves is on (reference runs
+    ALL move jobs on its bundled engine, src/queue.rs:562-568; skill
+    semantics in engine/tpu.py _move_job)."""
+    from fishnet_tpu.engine.tpu import TpuEngine
+    from fishnet_tpu.client.wire import EngineFlavor
+
+    engine = TpuEngine(max_depth=2)
+    # move jobs carry a hard 7 s deadline (src/api.rs:163-168): pre-compile
+    # the 64-lane program so the deadline race is about search, not XLA
+    engine.warmup(buckets=(64,))
+    server.add_move_job("mvtpu001", START, ["e2e4", "e7e5"], level=3)
+    py = PyEngine(max_depth=2)
+
+    def factory(flavor):
+        return engine if flavor is EngineFlavor.TPU else py
+
+    run_client_until(
+        server, lambda s: "mvtpu001" in s.moves,
+        tpu_variants={"standard"}, tpu_moves=True, factory=factory,
+        timeout=240.0,
+    )
+    body = server.moves["mvtpu001"]
+    from fishnet_tpu.chess import Position
+
+    pos = Position.initial().push_uci("e2e4").push_uci("e7e5")
+    legal = {m.uci() for m in pos.legal_moves()}
+    assert body["move"]["bestmove"] in legal

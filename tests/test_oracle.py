@@ -1,0 +1,115 @@
+"""Device search vs host oracle: exact score equality, with and without TT.
+
+The reference's search correctness is carried by Stockfish itself
+(reference: src/stockfish.rs drives it and trusts its output); the device
+search needs an explicit oracle instead. ops/oracle.py mirrors the device
+state machine move-for-move, so scores must agree EXACTLY — any drift is
+a search bug, not noise.
+
+All device searches here share ONE shape (B=50 lanes, max_ply=4) so the
+file pays two XLA compiles total (with/without TT) on the single-core CI
+box.
+"""
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from fishnet_tpu.chess import Position
+from fishnet_tpu.models import nnue
+from fishnet_tpu.ops import tt
+from fishnet_tpu.ops.board import from_position, stack_boards
+from fishnet_tpu.ops.oracle import oracle_search
+from fishnet_tpu.ops.search import search_batch_jit
+
+B = 50
+MAX_PLY = 4
+
+
+@pytest.fixture(scope="module", params=["board768", "halfkav2_hm"])
+def params(request):
+    return nnue.init_params(
+        jax.random.PRNGKey(0), l1=32, h1=8, h2=8, feature_set=request.param
+    )
+
+
+def _mixed_fens(n: int, seed: int = 7) -> list[str]:
+    """n positions sampled from seeded random games: openings through
+    endgames, captures, checks, promotions — whatever random play visits."""
+    rng = random.Random(seed)
+    fens = []
+    while len(fens) < n:
+        pos = Position.initial()
+        for ply in range(rng.randrange(2, 70)):
+            moves = pos.legal_moves()
+            if not moves:
+                break
+            pos = pos.push(rng.choice(moves))
+        fens.append(pos.to_fen())
+    return fens
+
+
+FENS = _mixed_fens(B)
+
+
+def _device(params, fens, depth, budget, table=None):
+    """One fixed-shape dispatch: fens cycled up to B lanes; per-lane depth
+    from the (possibly shorter) depth list."""
+    roots = stack_boards(
+        [from_position(Position.from_fen(fens[i % len(fens)])) for i in range(B)]
+    )
+    depth_arr = np.full(B, depth, np.int32)
+    out = search_batch_jit(
+        params, roots, depth_arr, np.full(B, budget, np.int32),
+        max_ply=MAX_PLY, tt=table,
+    )
+    return {k: np.asarray(v) for k, v in out.items() if k != "tt"}
+
+
+def _assert_matches(params, out, fens, depth, budget, idxs):
+    for i in idxs:
+        exp = oracle_search(
+            params, from_position(Position.from_fen(fens[i])), depth,
+            budget, MAX_PLY,
+        )
+        assert int(out["score"][i]) == exp["score"], (fens[i], depth)
+        assert int(out["nodes"][i]) == exp["nodes"], (fens[i], depth)
+
+
+def test_matches_oracle_depth1(params):
+    out = _device(params, FENS, 1, 100_000)
+    _assert_matches(params, out, FENS, 1, 100_000, range(len(FENS)))
+
+
+def test_matches_oracle_depth2(params):
+    n = 20 if nnue.is_board768(params) else 8
+    out = _device(params, FENS[:n], 2, 100_000)
+    _assert_matches(params, out, FENS[:n], 2, 100_000, range(n))
+
+
+@pytest.mark.slow
+def test_matches_oracle_depth3(params):
+    n = 6 if nnue.is_board768(params) else 3
+    out = _device(params, FENS[:n], 3, 100_000)
+    _assert_matches(params, out, FENS[:n], 3, 100_000, range(n))
+
+
+def test_budget_truncation_matches_oracle(params):
+    """The node-budget leaf rule is part of the semantics: a tiny budget
+    truncates the oracle and the device at the same node."""
+    n = 6
+    out = _device(params, FENS[:n], 3, 40)
+    _assert_matches(params, out, FENS[:n], 3, 40, range(n))
+
+
+def test_tt_scores_bit_identical(params):
+    """With exact-depth probe matching, the shared TT must not change any
+    score — only node counts (reference analog: analysis output must not
+    depend on what else the worker happened to search). At depth ≤3 a
+    repetition needs more reversible plies than the search has, so the
+    known graph-history interaction cannot bite here."""
+    plain = _device(params, FENS, 3, 1_000_000)
+    shared = _device(params, FENS, 3, 1_000_000, table=tt.make_table(18))
+    np.testing.assert_array_equal(plain["score"], shared["score"])
+    assert int(shared["nodes"].sum()) <= int(plain["nodes"].sum())

@@ -52,24 +52,22 @@ def test_stalemate_root(params):
     assert out["move"][0] == -1
 
 
-def test_depth1_matches_direct_eval(params):
+def test_depth1_matches_host_oracle(params):
+    """Depth 1 = one ply of all moves + capture quiescence at the
+    children; the host oracle (ops/oracle.py) models exactly that."""
+    from fishnet_tpu.ops.oracle import oracle_search
+
     fens = [
         "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
         "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
     ]
     out = run(params, fens, depth=1)
     for i, fen in enumerate(fens):
-        pos = Position.from_fen(fen)
-        best = None
-        for move in pos.legal_moves():
-            child = pos.push(move)
-            b = from_position(child)
-            v = -int(nnue.evaluate(params, b.board, b.stm))
-            v = max(min(v, MATE - 1000), -(MATE - 1000))
-            if best is None or v > best[0]:
-                best = (v, move.uci())
-        assert out["score"][i] == best[0], fen
-        assert decode(out["move"][i]) == best[1], fen
+        exp = oracle_search(
+            params, from_position(Position.from_fen(fen)), 1, 100_000, 2
+        )
+        assert out["score"][i] == exp["score"], fen
+        assert out["nodes"][i] == exp["nodes"], fen
 
 
 def test_pv_is_legal_line(params):

@@ -1,0 +1,111 @@
+"""Auto-updater end-to-end: fake bucket XML → self-replaced artifact →
+restart wiring (reference: src/update.rs:13-61, src/main.rs:50-68,
+180-200, 399-425)."""
+import asyncio
+import sys
+
+import pytest
+
+from fishnet_tpu.client import update
+from fishnet_tpu.client.update import auto_update, current_target
+
+
+class _Log:
+    def __init__(self):
+        self.lines = []
+
+    def debug(self, m):
+        self.lines.append(("D", m))
+
+    def info(self, m):
+        self.lines.append(("I", m))
+
+    def warn(self, m):
+        self.lines.append(("W", m))
+
+
+def _bucket_xml(keys):
+    items = "".join(
+        f"<Contents><Key>{k}</Key></Contents>" for k in keys
+    )
+    return (
+        '<?xml version="1.0"?>'
+        '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        f"{items}</ListBucketResult>"
+    )
+
+
+def test_auto_update_swaps_artifact(tmp_path, monkeypatch):
+    artifact = tmp_path / "fishnet-tpu.pyz"
+    artifact.write_bytes(b"old-zipapp")
+    monkeypatch.setattr(sys, "argv", [str(artifact), "run"])
+
+    target = current_target()
+    new_key = f"fishnet-tpu-v9.9.9-{target}.pyz"
+    xml = _bucket_xml(
+        [f"fishnet-tpu-v0.0.1-{target}.pyz", new_key, "other-v5.0.0-foo.pyz"]
+    )
+    fetched = []
+
+    async def http_get(url):
+        fetched.append(url)
+        if url.endswith(new_key):
+            return b"new-zipapp-bytes"
+        return xml.encode()
+
+    log = _Log()
+    ver = asyncio.run(auto_update(http_get, "https://bucket.example/", log))
+    assert ver == "9.9.9"
+    assert artifact.read_bytes() == b"new-zipapp-bytes"
+    assert fetched[-1].endswith(new_key)
+
+
+def test_auto_update_up_to_date(tmp_path, monkeypatch):
+    artifact = tmp_path / "fishnet-tpu.pyz"
+    artifact.write_bytes(b"current")
+    monkeypatch.setattr(sys, "argv", [str(artifact), "run"])
+    xml = _bucket_xml([f"fishnet-tpu-v0.0.1-{current_target()}.pyz"])
+
+    async def http_get(url):
+        return xml.encode()
+
+    ver = asyncio.run(auto_update(http_get, "https://bucket.example/", _Log()))
+    assert ver is None
+    assert artifact.read_bytes() == b"current"
+
+
+def test_auto_update_noop_from_source_tree(monkeypatch):
+    # running from a .py entry point: nothing replaceable, no fetches
+    monkeypatch.setattr(sys, "argv", ["/some/tree/__main__.py", "run"])
+    calls = []
+
+    async def http_get(url):
+        calls.append(url)
+        return b""
+
+    ver = asyncio.run(auto_update(http_get, "https://bucket.example/", _Log()))
+    assert ver is None
+    assert calls == []
+
+
+def test_app_startup_update_then_restart(monkeypatch):
+    """`run()` with --auto-update checks the bucket FIRST and re-execs on a
+    new version (reference: src/main.rs:50-68)."""
+    from fishnet_tpu.client import app
+    from fishnet_tpu.client.configure import Config
+
+    async def fake_auto_update(http_get, bucket, logger):
+        return "9.9.9"
+
+    class Restarted(BaseException):
+        pass
+
+    def fake_restart():
+        raise Restarted
+
+    monkeypatch.setattr(app, "auto_update", fake_auto_update)
+    monkeypatch.setattr(app, "restart_process", fake_restart)
+
+    cfg = Config(auto_update=True, key="testkey", cores=1)
+    with pytest.raises(Restarted):
+        asyncio.run(app.run(cfg))

@@ -1,0 +1,129 @@
+"""Head-to-head strength A/B: a board768 net (device search) vs PyEngine.
+
+VERDICT r1 #8's acceptance check: the shipped net must beat the old one
+head-to-head. This harness plays N games of (device search @ depth D)
+against PyEngine (material+mobility, depth d) from varied short random
+openings, alternating colors, and prints W/D/L + score.
+
+Usage:
+  python tools/strength_ab.py --net fishnet_tpu/assets/nnue-board768-64.npz \
+      --games 200 --depth 3
+  python tools/strength_ab.py --net old.npz --label old ...   # compare runs
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--net", required=True)
+    ap.add_argument("--games", type=int, default=200)
+    ap.add_argument("--depth", type=int, default=3)
+    ap.add_argument("--py-depth", type=int, default=2)
+    ap.add_argument("--max-plies", type=int, default=160)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--label", default="net")
+    args = ap.parse_args()
+
+    import jax
+
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import numpy as np
+
+    from fishnet_tpu.chess import Position
+    from fishnet_tpu.engine.pyengine import MATE_VALUE, PySearch
+    from fishnet_tpu.models import nnue
+    from fishnet_tpu.ops.board import from_position, stack_boards
+    from fishnet_tpu.ops.search import search_batch_jit
+
+    params = nnue.load_params(args.net)
+    rng = random.Random(args.seed)
+
+    def py_move(pos):
+        s = PySearch()
+        best, line = s.negamax(
+            pos, args.py_depth, -MATE_VALUE * 2, MATE_VALUE * 2, 0
+        )
+        return line[0] if line else None
+
+    def device_move(pos):
+        roots = stack_boards([from_position(pos)])
+        out = search_batch_jit(
+            params, roots, args.depth, 500_000, max_ply=args.depth + 3
+        )
+        m = int(np.asarray(out["move"])[0])
+        if m < 0:
+            return None
+        frm, to, promo = m & 63, (m >> 6) & 63, (m >> 12) & 7
+        uci = (
+            "abcdefgh"[frm & 7] + str((frm >> 3) + 1)
+            + "abcdefgh"[to & 7] + str((to >> 3) + 1)
+        )
+        if promo:
+            uci += " nbrq"[promo]
+        return uci
+
+    w = d = l = 0
+    for game in range(args.games):
+        pos = Position.initial()
+        for _ in range(rng.randrange(2, 6)):  # varied opening
+            moves = pos.legal_moves()
+            if not moves:
+                break
+            pos = pos.push(rng.choice(moves))
+        net_color = game % 2
+        plies = 0
+        outcome = None
+        while plies < args.max_plies:
+            oc = pos.outcome()
+            if oc is not None:
+                outcome = oc[0]
+                break
+            if not pos.legal_moves():
+                outcome = None
+                break
+            if pos.turn == net_color:
+                uci = device_move(pos)
+                if uci is None:
+                    break
+                pos = pos.push_uci(uci)
+            else:
+                uci = py_move(pos)
+                if uci is None:
+                    break
+                pos = pos.push_uci(uci)
+            plies += 1
+        if outcome is None:
+            d += 1
+        elif outcome == net_color:
+            w += 1
+        else:
+            l += 1
+        if (game + 1) % 10 == 0:
+            print(
+                f"[{args.label}] {game + 1}/{args.games}: +{w} ={d} -{l} "
+                f"score {(w + 0.5 * d) / (game + 1):.3f}",
+                flush=True,
+            )
+    print(
+        f"[{args.label}] final: +{w} ={d} -{l} over {args.games} games, "
+        f"score {(w + 0.5 * d) / max(args.games, 1):.3f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
