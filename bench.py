@@ -8,19 +8,37 @@ scheduling prior (400 knps, reference: src/stats.rs:203-214) × host cores —
 the documented proxy for "Stockfish-AVX2 on the same host" since this image
 bundles no Stockfish binary to measure directly.
 
-The search dispatches in bounded segments (ops/search.py
-search_batch_resumable) so no single device program runs unboundedly; a
-transient device/tunnel error is retried, then the batch shrinks.
+Hang-proofing (round-2 lesson: a device-side hang starved the in-process
+ramp and the artifact recorded nothing): every stage runs in its OWN
+subprocess with its own wall-clock timeout, and streams timestamped
+phase heartbeats (compile_start / compile_done / exec segments) to stderr
+so a recorded tail localizes any hang to compile vs run. A stage that
+dies never takes the harness down; the final JSON always prints.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+# (lanes, depth) ramp: known-good shapes first (docs/tpu-hang.md bisection),
+# so small real numbers are on record before the north-star shape — which is
+# attempted last because a hang there can wedge the tunnel for later stages
+STAGES = [(8, 2), (64, 2), (8, 3), (256, 4)]
 
-def run_once(B: int, depth: int, budget: int):
+
+def _hb(t0: float, msg: str) -> None:
+    print(f"[bench {time.time() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def stage_main(B: int, depth: int, budget: int) -> None:
+    """Child process: run one (B, depth) stage with phase heartbeats.
+
+    On success prints exactly one stdout line: RESULT {json}."""
+    t0 = time.time()
+    _hb(t0, f"stage B={B} depth={depth}: importing jax")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -28,11 +46,13 @@ def run_once(B: int, depth: int, budget: int):
     from fishnet_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
+    platform = jax.default_backend()
+    _hb(t0, f"devices={jax.devices()} platform={platform}")
 
     from fishnet_tpu.chess import Position
     from fishnet_tpu.models import nnue
     from fishnet_tpu.ops.board import from_position, stack_boards
-    from fishnet_tpu.ops.search import search_batch_resumable
+    from fishnet_tpu.ops import search as S
 
     # a spread of real game positions (openings → endgames)
     fens = [
@@ -46,10 +66,10 @@ def run_once(B: int, depth: int, budget: int):
         "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
     ]
     positions = [Position.from_fen(f) for f in fens]
-    lanes = [from_position(positions[i % len(positions)]) for i in range(B)]
-    roots = stack_boards(lanes)
+    roots = stack_boards(
+        [from_position(positions[i % len(positions)]) for i in range(B)]
+    )
     params = nnue.init_params(jax.random.PRNGKey(0), l1=64, feature_set="board768")
-
     max_ply = depth + 1
     depth_arr = jnp.full((B,), depth, jnp.int32)
     budget_arr = jnp.full((B,), budget, jnp.int32)
@@ -62,77 +82,156 @@ def run_once(B: int, depth: int, budget: int):
         from fishnet_tpu.ops import tt as tt_mod
 
         tt = tt_mod.make_table(tt_log2)
+    _hb(t0, "inputs built")
 
-    # warmup / compile
-    out = search_batch_resumable(
-        params, roots, depth_arr, budget_arr, max_ply=max_ply, tt=tt
+    # compile each program explicitly so a compiler hang is distinguishable
+    # from an execution hang in the heartbeat tail
+    _hb(t0, "compile_start init_state")
+    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, "standard")
+    jax.block_until_ready(state.board)
+    _hb(t0, "compile_done init_state (and executed)")
+    seg = 20_000
+    _hb(t0, f"compile_start run_segment(seg={seg})")
+    lowered = S._run_segment_jit.lower(params, state, tt, seg, "standard")
+    _hb(t0, "  lowered")
+    lowered.compile()
+    _hb(t0, "compile_done run_segment")
+
+    _hb(t0, "exec_start warmup search")
+    out = S.search_batch_resumable(
+        params, roots, depth_arr, budget_arr, max_ply=max_ply,
+        segment_steps=seg, tt=tt,
     )
     tt = out.pop("tt")
     jax.block_until_ready(out["nodes"])
+    _hb(t0, f"exec_done warmup (steps={int(out['steps'])})")
 
-    t0 = time.perf_counter()
-    out = search_batch_resumable(
-        params, roots, depth_arr, budget_arr, max_ply=max_ply, tt=tt
+    _hb(t0, "exec_start timed search")
+    t1 = time.perf_counter()
+    out = S.search_batch_resumable(
+        params, roots, depth_arr, budget_arr, max_ply=max_ply,
+        segment_steps=seg, tt=tt,
     )
     out.pop("tt")
     jax.block_until_ready(out["nodes"])
-    dt = time.perf_counter() - t0
-
+    dt = time.perf_counter() - t1
     total_nodes = int(np.asarray(out["nodes"]).sum())
-    return total_nodes / dt
+    _hb(t0, f"exec_done timed: {total_nodes:,} nodes in {dt:.2f}s")
+
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "nps": total_nodes / dt,
+                "B": B,
+                "depth": depth,
+                "nodes": total_nodes,
+                "dt": dt,
+                "platform": platform,
+            }
+        ),
+        flush=True,
+    )
+
+
+def run_stage(B: int, depth: int, budget: int, timeout: float,
+              force_cpu: bool = False) -> dict | None:
+    """Parent: launch one stage subprocess; return its RESULT or None."""
+    import tempfile
+
+    t0 = time.time()
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--stage", str(B), str(depth), str(budget)]
+    env = dict(os.environ)
+    if force_cpu:
+        env["BENCH_FORCE_CPU"] = "1"
+    # child stderr goes to a file, not a pipe: on timeout-kill a pipe's
+    # contents are lost (TimeoutExpired.stderr is None on this platform),
+    # and the heartbeat tail is most needed exactly then
+    with tempfile.NamedTemporaryFile("w+", suffix=".bench-hb") as hb:
+        try:
+            r = subprocess.run(
+                cmd, stdout=subprocess.PIPE, stderr=hb, text=True,
+                timeout=timeout, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            hb.seek(0)
+            tail = "".join(
+                l for l in hb.read()[-4000:].splitlines(True)
+                if "experimental" not in l
+            )
+            print(f"bench stage B={B} d={depth} TIMED OUT after "
+                  f"{timeout:.0f}s; heartbeat tail:\n{tail}",
+                  file=sys.stderr, flush=True)
+            return None
+        hb.seek(0)
+        for line in hb.read().splitlines():
+            if "experimental" not in line:
+                print(line, file=sys.stderr, flush=True)
+    if r.returncode != 0:
+        print(f"bench stage B={B} d={depth} rc={r.returncode} "
+              f"({time.time() - t0:.0f}s)", file=sys.stderr, flush=True)
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    print(f"bench stage B={B} d={depth}: no RESULT line", file=sys.stderr)
+    return None
 
 
 def main() -> None:
     B = int(os.environ.get("BENCH_LANES", "256"))
     DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
     BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
+    stage_timeout = float(os.environ.get("BENCH_STAGE_TIMEOUT", "420"))
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1800"))
+    t_start = time.time()
 
-    # ramp up through configs so a crash at the big shape still leaves the
-    # largest WORKING number on record (r1 recorded nothing because all
-    # attempts used the big shape). Each stage retries once.
-    stages = [(8, 2), (64, 3), (B, DEPTH)]
-    best = None  # (nps, b, d)
-    last_err = None
+    stages = [s for s in STAGES if s[0] <= B]
+    if (B, DEPTH) not in stages:
+        stages.append((B, DEPTH))
+
+    best = None  # result dict with max nps
+    fails = 0
     for b, d in stages:
-        ok = False
-        for attempt in range(2):
-            try:
-                t0 = time.perf_counter()
-                nps = run_once(b, d, BUDGET)
-                dt = time.perf_counter() - t0
-                print(f"bench stage B={b} depth={d}: {nps:,.0f} nodes/s "
-                      f"({dt:.1f}s incl. warmup)", file=sys.stderr)
-                best = (nps, b, d)
-                ok = True
+        if time.time() - t_start > total_budget - stage_timeout:
+            print("bench: total budget nearly spent; stopping ramp",
+                  file=sys.stderr, flush=True)
+            break
+        res = run_stage(b, d, BUDGET, stage_timeout)
+        if res is None:
+            fails += 1
+            if fails >= 2:
+                # two consecutive dead stages: the device (or tunnel) is
+                # gone; don't burn the rest of the budget on it
+                print("bench: two consecutive stage failures; stopping ramp",
+                      file=sys.stderr, flush=True)
                 break
-            except Exception as e:
-                last_err = e
-                print(f"bench stage (B={b}, depth={d}) attempt {attempt} "
-                      f"failed: {e}", file=sys.stderr)
-                time.sleep(10.0)
-        if not ok:
-            break  # don't push a crashing device harder
+            continue
+        fails = 0
+        if best is None or res["nps"] > best["nps"]:
+            best = res
 
     label = ""
     if best is None:
         # device unusable: measure the same program on CPU so the record
         # is a clearly-labelled fallback number, not a crash log
-        print(f"device bench failed entirely ({last_err}); "
-              "falling back to CPU", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            import jax
-            import jax._src.xla_bridge as _xb
+        print("device bench failed entirely; falling back to CPU",
+              file=sys.stderr, flush=True)
+        remaining = total_budget - (time.time() - t_start)
+        best = run_stage(8, 2, BUDGET,
+                         max(60.0, min(stage_timeout * 2, remaining)),
+                         force_cpu=True)
+        label = " [CPU FALLBACK — device unusable]"
 
-            _xb._backend_factories.pop("axon", None)
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-        nps = run_once(16, 2, BUDGET)
-        best = (nps, 16, 2)
-        label = " [CPU FALLBACK — device crashed]"
+    if best is None:
+        print(json.dumps({
+            "metric": "batched alpha-beta+NNUE nodes/sec/chip [ALL STAGES FAILED]",
+            "value": 0, "unit": "nodes/sec", "vs_baseline": 0.0,
+        }))
+        return
 
-    nps, b, d = best
     cores = os.cpu_count() or 1
     baseline = 400_000 * cores  # reference NPS prior × host cores
     print(
@@ -140,15 +239,21 @@ def main() -> None:
             {
                 "metric": (
                     f"batched alpha-beta+NNUE nodes/sec/chip "
-                    f"(B={b}, depth={d}){label}"
+                    f"(B={best['B']}, depth={best['depth']}, "
+                    f"platform={best['platform']}){label}"
                 ),
-                "value": round(nps),
+                "value": round(best["nps"]),
                 "unit": "nodes/sec",
-                "vs_baseline": round(nps / baseline, 4),
+                "vs_baseline": round(best["nps"] / baseline, 4),
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--stage":
+        if os.environ.get("BENCH_FORCE_CPU"):
+            from tools import force_cpu  # noqa: F401  (deregisters axon)
+        stage_main(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        main()

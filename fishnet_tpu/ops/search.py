@@ -39,6 +39,7 @@ from .board import (
     move_piece_changes,
 )
 from .movegen import MAX_MOVES, generate_moves, max_moves_for
+from . import tt as _tt_mod
 
 INF = 32500
 MATE = 32000
@@ -190,9 +191,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # (halfmove[ply]-halfmove[k] == ply-k). Path-dependent by nature, so
     # repetition draws are never TT-stored and never TT-overridden; the
     # residual graph-history interaction is the same approximation every
-    # real engine ships.
-    from . import tt as _tt_mod
-
+    # real engine ships. (_tt_mod is imported at module top: importing it
+    # lazily inside this jit-traced function once leaked its module-level
+    # Zobrist tables as tracers — see round-2 verdict.)
     h1, h2 = _tt_mod.hash_board(
         b.board, us, b.ep, b.castling, b.extra, variant
     )
@@ -490,7 +491,6 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
     into the vmapped step. Stores from one lane are visible to every
     other lane in the same iteration — the cross-lane sharing that makes
     one HBM table worth more than B private ones."""
-    from . import tt as tt_mod
 
     if ttab is None:
         step = make_search_step(params, variant)
@@ -509,7 +509,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             ca = _gather_ply(s.castling, s.ply)
             ex = _gather_ply(s.extra, s.ply)
             h1, h2 = jax.vmap(
-                lambda b_, s_, e_, c_, x_: tt_mod.hash_board(
+                lambda b_, s_, e_, c_, x_: _tt_mod.hash_board(
                     b_, s_, e_, c_, x_, variant
                 )
             )(bb, st, epv, ca, ex)
@@ -531,13 +531,13 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             alpha0_at = _gather_ply(s.alpha0, s.ply)
             flag = jnp.where(
                 s.ret >= beta_at,
-                tt_mod.FLAG_LOWER,
+                _tt_mod.FLAG_LOWER,
                 jnp.where(
-                    s.ret <= alpha0_at, tt_mod.FLAG_UPPER, tt_mod.FLAG_EXACT
+                    s.ret <= alpha0_at, _tt_mod.FLAG_UPPER, _tt_mod.FLAG_EXACT
                 ),
             )
             bm = _gather_ply(s.best_move, s.ply)
-            t = tt_mod.store(
+            t = _tt_mod.store(
                 t, h1, h2, s.ret, jnp.maximum(s.ret_depth, 0), flag, bm,
                 store_mask,
             )
@@ -547,7 +547,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             parent = jnp.maximum(s.ply - 1, 0)
             a_w = jnp.where(s.ply == 0, -INF, -_gather_ply(s.beta, parent))
             b_w = jnp.where(s.ply == 0, INF, -_gather_ply(s.alpha, parent))
-            usable, score, _mv, order_mv = tt_mod.probe(
+            usable, score, _mv, order_mv = _tt_mod.probe(
                 t, h1, h2, s.depth_limit - s.ply, a_w, b_w
             )
             usable &= enter
@@ -557,9 +557,9 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             # ---- store leaves the step just evaluated (depth-0 EXACT).
             # Their hash is the PRE-step hash: a marking lane was in ENTER
             # at this ply, exactly the position h1/h2 were computed for.
-            t = tt_mod.store(
+            t = _tt_mod.store(
                 t, h1, h2, s.store_val, jnp.zeros_like(s.store_val),
-                jnp.full_like(s.store_val, tt_mod.FLAG_EXACT),
+                jnp.full_like(s.store_val, _tt_mod.FLAG_EXACT),
                 jnp.full_like(s.store_val, -1), s.store_mark,
             )
             return s, t, i + 1
