@@ -408,6 +408,9 @@ class Position:
     def _double_push_sources(self, us: int) -> int:
         return RANK_2 if us == WHITE else RANK_7
 
+    def _double_sets_ep(self, frm: int, us: int) -> bool:
+        return True  # horde: back-rank doubles can't be captured en passant
+
     def _promotion_pieces(self) -> Tuple[int, ...]:
         return (QUEEN, ROOK, BISHOP, KNIGHT)
 
@@ -609,7 +612,9 @@ class Position:
 
             if ptype == PAWN:
                 self.halfmove = 0
-                if abs(move.to_sq - move.from_sq) == 16:
+                if abs(move.to_sq - move.from_sq) == 16 and self._double_sets_ep(
+                    move.from_sq, us
+                ):
                     new_ep = (move.from_sq + move.to_sq) // 2
             if move.promotion is not None:
                 self._set_piece(move.to_sq, us, move.promotion, promoted=self.pockets is not None)

@@ -169,6 +169,10 @@ class HordePosition(Position):
             return RANK_1 | RANK_2
         return RANK_7
 
+    def _double_sets_ep(self, frm: int, us: int) -> bool:
+        # a double push from the back rank cannot be captured en passant
+        return not (us == WHITE and square_rank(frm) == 0)
+
     def _variant_outcome(self) -> Optional[Tuple[Optional[int], str]]:
         if not self.occ[WHITE]:
             return (BLACK, "horde destroyed")

@@ -91,6 +91,7 @@ class UrllibTransport:
         self._pool: dict = {}  # (scheme, netloc) -> [(conn, last_used)]
 
     def _get_conn(self, scheme: str, netloc: str):
+        """→ (conn, reused): reused=True for a kept-alive pooled socket."""
         import time as _time
 
         with self._lock:
@@ -98,11 +99,11 @@ class UrllibTransport:
             while entries:
                 conn, last = entries.pop()
                 if _time.monotonic() - last < self.IDLE_TIMEOUT_S:
-                    return conn
+                    return conn, True
                 conn.close()
         if scheme == "https":
-            return http.client.HTTPSConnection(netloc, timeout=self.timeout)
-        return http.client.HTTPConnection(netloc, timeout=self.timeout)
+            return http.client.HTTPSConnection(netloc, timeout=self.timeout), False
+        return http.client.HTTPConnection(netloc, timeout=self.timeout), False
 
     def _put_conn(self, scheme: str, netloc: str, conn) -> None:
         import time as _time
@@ -119,9 +120,8 @@ class UrllibTransport:
 
         parts = urlsplit(url)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
-        last_exc: Optional[Exception] = None
-        for attempt in range(2):  # retry once on a stale kept-alive socket
-            conn = self._get_conn(parts.scheme, parts.netloc)
+        while True:  # drain stale kept-alive sockets, then one fresh try
+            conn, reused = self._get_conn(parts.scheme, parts.netloc)
             try:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
@@ -131,10 +131,21 @@ class UrllibTransport:
                 else:
                     self._put_conn(parts.scheme, parts.netloc, conn)
                 return HttpResponse(resp.status, data)
-            except (ConnectionError, OSError, http.client.HTTPException) as e:
+            except TimeoutError:
+                # a slow server may still be processing the delivered
+                # body; retrying would duplicate a non-idempotent POST
+                # (acquire/submit/move) — surface it instead
                 conn.close()
-                last_exc = e
-        raise last_exc  # type: ignore[misc]
+                raise
+            except (ConnectionError, OSError, http.client.HTTPException):
+                conn.close()
+                if not reused:
+                    # fresh-socket failure is a real error, not the
+                    # stale-keep-alive case the retry exists for — and
+                    # the request may already have reached the server
+                    raise
+                # reused sockets are finite (each failure pops one), so
+                # this terminates at a fresh connection at the latest
 
 
 class ApiError(Exception):

@@ -40,9 +40,12 @@ async def _http_get(url: str) -> bytes:
 def tpu_variants_for(cfg: Config) -> Optional[Set[str]]:
     if cfg.backend != "tpu":
         return None
-    # orthodox movegen + the device-side variant programs
+    # all seven lichess variants run on device
     # (engine/tpu.py DEVICE_VARIANTS; ops/ variant static flags)
-    return {"standard", "chess960", "fromPosition", "threeCheck", "crazyhouse"}
+    return {
+        "standard", "chess960", "fromPosition", "threeCheck", "crazyhouse",
+        "antichess", "atomic", "horde", "kingOfTheHill", "racingKings",
+    }
 
 
 def make_engine_factory(cfg: Config, logger: Logger):

@@ -27,10 +27,15 @@ from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
 from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
 from ..ops.board import from_position, stack_boards
-from ..ops.search import MATE, search_batch_resumable
+from ..ops.search import INF, MATE, search_batch_resumable
 from .base import EngineError
 
-MAX_PLY = 24  # static stack depth; supports search depths up to 23
+# static stack depth; supports search depths up to MAX_PLY-1, with the
+# tail past the nominal depth doubling as quiescence headroom (32 leaves
+# depth-22 move jobs 10 QS plies — reference skill-8 depth, src/api.rs:275-281).
+# Env-tunable because compile cost scales with it: tests and CPU smoke runs
+# set a small value (the full program takes minutes to compile on XLA:CPU)
+MAX_PLY = int(os.environ.get("FISHNET_TPU_MAX_PLY", "32"))
 # 16 covers every single-pv chunk (planner emits ≤10 positions per chunk,
 # incl. skip-overlap re-appends — client/planner.py); 64 covers multipv
 # root-move lanes. Fewer buckets = fewer cold XLA compiles to warm up.
@@ -51,7 +56,9 @@ def _decode_uci(m: int) -> str:
 
 
 # chunk.variant → device search program (ops/search.py static flag);
-# variants not listed fall back to host engines via the planner routing
+# variants not listed fall back to host engines via the planner routing.
+# All seven lichess variants the reference analyses (src/logger.rs:201-213)
+# run on device.
 DEVICE_VARIANTS = {
     "standard": "standard",
     "chess960": "standard",
@@ -59,6 +66,11 @@ DEVICE_VARIANTS = {
     "threeCheck": "threeCheck",
     "3check": "threeCheck",
     "crazyhouse": "crazyhouse",
+    "antichess": "antichess",
+    "atomic": "atomic",
+    "horde": "horde",
+    "kingOfTheHill": "kingOfTheHill",
+    "racingKings": "racingKings",
 }
 
 
@@ -134,6 +146,10 @@ class TpuEngine:
                 params = nnue.init_params(
                     jax.random.PRNGKey(seed), l1=64, feature_set="board768"
                 )
+        # FISHNET_TPU_DTYPE=bf16 quantizes the weights to the MXU's
+        # native input type (SURVEY §7.2); accumulators stay f32
+        if os.environ.get("FISHNET_TPU_DTYPE", "").lower() in ("bf16", "bfloat16"):
+            params = nnue.cast_params(params, jnp.bfloat16)
         self.params = params
         self.max_depth = max_depth
 
@@ -183,17 +199,117 @@ class TpuEngine:
         return b
 
     def _search(self, roots, depth_arr, budget_arr, deadline=None,
-                variant="standard"):
+                variant="standard", hist=None, window=None):
         # the TT is shared across variants: variant state is hashed into
         # the key (ops/tt.py), so entries can't collide across rule sets
         out = search_batch_resumable(
             self.params, roots, jnp.asarray(depth_arr),
             jnp.asarray(budget_arr), max_ply=MAX_PLY,
             deadline=deadline, tt=self.tt, mesh=self.mesh,
-            variant=variant,
+            variant=variant, hist=hist, window=window,
         )
         self.tt = out.pop("tt")
         return {k: np.asarray(v) for k, v in out.items()}
+
+    def _search_windowed(self, roots, depth_arr, budget_arr, deadline,
+                         variant, hist, prev_score, use_win):
+        """Aspiration-windowed dispatch (classic iterative-deepening win:
+        a narrow window around the previous depth's score cuts most of
+        the tree; a fail-low/high re-searches wider, settled lanes ride
+        along at depth 0 / budget 1). Returns the merged result dict with
+        per-lane nodes summed over attempts."""
+        B = int(depth_arr.shape[0])
+        deltas = (30, 200, None)  # None = full window
+        merged = None
+        nodes_acc = np.zeros(B, np.int64)
+        live = np.ones(B, bool)
+        prev_score = np.asarray(prev_score, np.int64)
+        for delta in deltas:
+            if delta is None or not use_win.any():
+                alpha_w = np.full(B, -INF, np.int32)
+                beta_w = np.full(B, INF, np.int32)
+            else:
+                alpha_w = np.where(use_win, prev_score - delta, -INF).astype(np.int32)
+                beta_w = np.where(use_win, prev_score + delta, INF).astype(np.int32)
+            out = self._search(
+                roots,
+                np.where(live, depth_arr, 0).astype(np.int32),
+                np.where(live, budget_arr, 1).astype(np.int32),
+                deadline, variant=variant, hist=hist,
+                window=(alpha_w, beta_w),
+            )
+            if merged is None:
+                merged = {k: np.array(v) for k, v in out.items()}
+            else:
+                for k in ("score", "move", "pv", "pv_len", "done"):
+                    merged[k][live] = out[k][live]
+            nodes_acc[live] += out["nodes"][live]
+            score = out["score"]
+            fail = (
+                live
+                & out["done"]
+                & (
+                    ((score <= alpha_w) & (alpha_w > -INF))
+                    | ((score >= beta_w) & (beta_w < INF))
+                )
+            )
+            # lanes that didn't finish (deadline) stay merged as not-done
+            live = fail
+            if not live.any():
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                # fail-low/high lanes hold only a BOUND — without the
+                # wider re-search it must not be reported as a score
+                merged["done"][live] = False
+                break
+        merged["nodes"] = nodes_acc
+        return merged
+
+    @staticmethod
+    def _history_arrays(hist_lists, B, variant="standard"):
+        """Per-lane reversible game tails → device seed arrays.
+
+        hist_lists: list (≤B) of list[Position], oldest first, ending at
+        the lane root's parent. The reference hands the engine the whole
+        game (`position fen ... moves ...`, src/stockfish.rs:298-306), and
+        Stockfish's draw rule (Position::is_draw) scores a repetition as
+        a draw when the earlier occurrence is INSIDE the search path, or
+        when the position already occurred twice before/at the root. The
+        in-search half is the device's path scan; this seeds the other
+        half: only game positions occurring >=2x in the reversible tail
+        are planted (a single pre-root occurrence is NOT a draw on
+        re-visit — distance > ply in Stockfish's check). Chain validity
+        (no irreversible move in between, rule50 window) is re-checked on
+        device via halfmove distances."""
+        from ..ops import tt as tt_mod
+        from ..ops.search import HIST_HM_SENTINEL, MAX_HIST
+
+        hh = np.zeros((B, MAX_HIST, 2), np.uint32)
+        hm = np.full((B, MAX_HIST), HIST_HM_SENTINEL, np.int32)
+        flat, slots = [], []
+        for lane, hist in enumerate(hist_lists):
+            tail = hist[-MAX_HIST:]
+            for j, p in enumerate(tail):
+                slots.append((lane, MAX_HIST - len(tail) + j))
+                flat.append(from_position(p))
+        if flat:
+            stacked = stack_boards(flat)
+            h1, h2 = tt_mod.hash_boards(stacked, variant)
+            h1, h2 = np.asarray(h1), np.asarray(h2)
+            hms = np.asarray(stacked.halfmove)
+            for n, (lane, k) in enumerate(slots):
+                hh[lane, k, 0] = h1[n]
+                hh[lane, k, 1] = h2[n]
+                hm[lane, k] = hms[n]
+            # keep only positions occurring >=2x within their lane's tail
+            for lane in range(B):
+                filled = hm[lane] != HIST_HM_SENTINEL
+                pairs = [tuple(hh[lane, k]) for k in range(MAX_HIST)]
+                for k in range(MAX_HIST):
+                    if filled[k] and pairs.count(pairs[k]) < 2:
+                        hm[lane, k] = HIST_HM_SENTINEL
+                        hh[lane, k] = 0
+        return hh, hm
 
     def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
         with self._lock:
@@ -202,15 +318,19 @@ class TpuEngine:
     def _go_multiple_locked(self, chunk: Chunk) -> List[PositionResponse]:
         started = time.monotonic()
         positions = []
+        games = []  # per position: the replayed game prefix (oldest first)
         for wp in chunk.positions:
             pos = from_fen(wp.root_fen, chunk.variant)
+            prefix = []
             for uci in wp.moves:
+                prefix.append(pos)
                 pos = pos.push(pos.parse_uci(uci))
             positions.append(pos)
+            games.append(prefix)
 
         work = chunk.work
         if isinstance(work, MoveWork):
-            return self._move_job(chunk, positions, work, started)
+            return self._move_job(chunk, positions, games, work, started)
         assert isinstance(work, AnalysisWork)
         multipv = work.effective_multipv()
         target_depth = min(work.depth or self.max_depth, self.max_depth, MAX_PLY - 1)
@@ -218,15 +338,15 @@ class TpuEngine:
 
         if multipv > 1:
             responses = self._analyse_multipv(
-                chunk, positions, multipv, target_depth, budget, started
+                chunk, positions, games, multipv, target_depth, budget, started
             )
         else:
             responses = self._analyse_single(
-                chunk, positions, target_depth, budget, started
+                chunk, positions, games, target_depth, budget, started
             )
         return responses
 
-    def _move_job(self, chunk, positions, work: MoveWork, started):
+    def _move_job(self, chunk, positions, games, work: MoveWork, started):
         """Play jobs with lichess skill semantics (reference:
         src/api.rs:248-283 maps level 1-8 → movetime/Skill Level/depth;
         src/stockfish.rs:309-333 passes them to the engine).
@@ -249,7 +369,7 @@ class TpuEngine:
         )
 
         responses = []
-        for wp, pos in zip(chunk.positions, positions):
+        for wp, pos, game in zip(chunk.positions, positions, games):
             if pos.outcome() is not None:
                 responses.append(self._terminal_response(chunk, wp, pos, 0.001))
                 continue
@@ -257,6 +377,10 @@ class TpuEngine:
             B = self._pad(max(len(legal), 1))
             boards = [from_position(pos.push(m)) for m in legal]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+            # every root-move lane shares the same history: the game
+            # prefix plus the position the move was played from
+            variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
+            hist = self._history_arrays([game + [pos]] * B, B, variant)
 
             ranked = []
             depth_reached = 0
@@ -267,7 +391,7 @@ class TpuEngine:
                 out = self._search(
                     roots, depth_arr, np.full(B, 10_000_000, np.int32),
                     hard_deadline if depth == 1 else soft_deadline,
-                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
+                    variant=variant, hist=hist,
                 )
                 if not bool(out["done"][: len(legal)].all()):
                     break  # movetime/deadline hit: keep the previous depth
@@ -322,7 +446,8 @@ class TpuEngine:
             time_s=elapsed,
         )
 
-    def _analyse_single(self, chunk, positions, target_depth, budget, started):
+    def _analyse_single(self, chunk, positions, games, target_depth, budget,
+                        started):
         terminal = {
             i for i, p in enumerate(positions) if p.outcome() is not None
         }
@@ -339,17 +464,25 @@ class TpuEngine:
             boards = [from_position(positions[i]) for i in lanes]
             pad = from_position(positions[lanes[0]])
             roots = stack_boards(boards + [pad] * (B - len(boards)))
+            variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
+            hist = self._history_arrays([games[i] for i in lanes], B, variant)
             per_pos_budget = budget if budget is not None else 10_000_000
             remaining = np.full(B, per_pos_budget, dtype=np.int64)
+            prev_score = np.zeros(B, np.int64)
+            have_prev = np.zeros(B, bool)
 
             deadline = chunk.deadline - 0.25  # leave slack to package results
             for depth in range(1, target_depth + 1):
                 depth_arr = np.zeros(B, np.int32)
                 depth_arr[: len(lanes)] = depth
                 budget_arr = np.clip(remaining, 0, 2**31 - 1).astype(np.int32)
-                out = self._search(
+                use_win = (
+                    have_prev & (np.abs(prev_score) < MATE - 1000)
+                    & (depth >= 2)
+                )
+                out = self._search_windowed(
                     roots, depth_arr, budget_arr, deadline,
-                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
+                    variant, hist, prev_score, use_win,
                 )
                 exhausted_all = True
                 for j, i in enumerate(lanes):
@@ -358,6 +491,8 @@ class TpuEngine:
                     nodes_total[i] += int(out["nodes"][j])
                     remaining[j] -= int(out["nodes"][j])
                     sc = int(out["score"][j])
+                    prev_score[j] = sc
+                    have_prev[j] = True
                     scores[i].set(1, depth, _score_from_int(sc))
                     pv = [
                         _decode_uci(int(m))
@@ -399,7 +534,7 @@ class TpuEngine:
             )
         return responses
 
-    def _analyse_multipv(self, chunk, positions, multipv, target_depth,
+    def _analyse_multipv(self, chunk, positions, games, multipv, target_depth,
                          budget, started):
         """MultiPV via root-move-partitioned lanes: every legal root move
         of EVERY chunk position becomes a lane, all searched together in
@@ -428,6 +563,12 @@ class TpuEngine:
         if boards:
             B = self._pad(max(len(boards), 64))
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+            variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
+            # lane k's root is positions[lane_pos[k]].push(move): history =
+            # that game's prefix plus the position itself
+            hist = self._history_arrays(
+                [games[i] + [positions[i]] for i in lane_pos], B, variant
+            )
             per_pos_budget = budget if budget is not None else 10_000_000
             remaining = {i: per_pos_budget for i in live}
 
@@ -444,7 +585,7 @@ class TpuEngine:
                         )
                 out = self._search(
                     roots, depth_arr, budget_arr, deadline,
-                    variant=DEVICE_VARIANTS.get(chunk.variant, "standard"),
+                    variant=variant, hist=hist,
                 )
                 done = out["done"]
                 # fold lanes back per position

@@ -183,6 +183,16 @@ def apply_acc_updates_768(params: NnueParams, acc: jnp.ndarray,
     return acc
 
 
+def cast_params(params: NnueParams, dtype=jnp.bfloat16) -> NnueParams:
+    """Quantize the network weights (bf16 by default — the MXU's native
+    input type; SURVEY §7.2). Search accumulators stay f32 (init_state
+    allocates acc in f32 regardless), so incremental updates keep their
+    precision; matmuls run bf16×f32→f32 which XLA maps onto the MXU.
+    Evaluations may drift a few centipawns vs f32 — use the f32 master
+    weights for training and parity tests."""
+    return NnueParams(*[jnp.asarray(a).astype(dtype) for a in params])
+
+
 def is_board768(params) -> bool:
     return (
         isinstance(params, NnueParams)

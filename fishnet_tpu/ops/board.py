@@ -54,15 +54,20 @@ def from_position(pos: Position) -> Board:
             for sq in scan(pos.bbs[color][ptype]):
                 board[sq] = 1 + ptype + 6 * color
     castling = np.full(4, -1, dtype=np.int32)
-    for color in (0, 1):
-        ksq = pos.king_sq(color)
-        back = 0xFF if color == 0 else 0xFF << 56
-        rights = pos.castling & back
-        for rsq in scan(rights):
-            if ksq is None:
-                continue
-            side = 0 if rsq > ksq else 1
-            castling[color * 2 + side] = rsq
+    # variants without castling (antichess, racingKings) never carry
+    # rights on device — the host parses-but-ignores any FEN rights
+    # (Position.has_castling), and the device movegen would otherwise
+    # generate castle moves from them
+    if getattr(pos, "has_castling", True):
+        for color in (0, 1):
+            ksq = pos.king_sq(color)
+            back = 0xFF if color == 0 else 0xFF << 56
+            rights = pos.castling & back
+            for rsq in scan(rights):
+                if ksq is None:
+                    continue
+                side = 0 if rsq > ksq else 1
+                castling[color * 2 + side] = rsq
     extra = np.zeros(EXTRA_W, dtype=np.int32)
     if getattr(pos, "variant", "standard") == "threeCheck":
         for color in (0, 1):
@@ -150,6 +155,91 @@ def in_check(b: Board) -> jnp.ndarray:
     )
 
 
+# variant-terminal kinds, from the side to move's perspective
+TERM_NONE, TERM_LOSS, TERM_WIN, TERM_DRAW = 0, 1, 2, 3
+
+
+def node_rules(b: Board, variant: str = "standard"):
+    """Per-node legality + variant-terminal classification (unbatched).
+
+    The reference delegates these rules to Fairy-Stockfish
+    (src/stockfish.rs:245-260 sets UCI_Variant); here each variant is a
+    statically compiled branch shared by the device search step and the
+    host oracle. Host rule spec: chess/variants.py. Returns:
+    - parent_illegal: the move leading HERE violated the mover's duty
+      (left its king en prise; exploded its own king in atomic; gave
+      check in racingKings). The search refutes the parent move.
+    - checked: side to move is in check (mate vs stalemate scoring).
+    - term_kind: TERM_* game end by variant rule at this node
+      (TERM_LOSS → -(MATE-ply), TERM_WIN → MATE-ply, TERM_DRAW → 0).
+    """
+    us = b.stm
+    them = 1 - us
+    our_k = king_square(b.board, us)
+    their_k = king_square(b.board, them)
+    our_k_c = jnp.maximum(our_k, 0)
+    their_k_c = jnp.maximum(their_k, 0)
+    self_check = (their_k < 0) | is_attacked(b.board, their_k_c, us)
+    checked = (our_k >= 0) & is_attacked(b.board, our_k_c, them)
+    kind = jnp.int32(TERM_NONE)
+
+    if variant == "antichess":
+        # no check concept, kings are ordinary pieces; running out of
+        # moves/pieces WINS (handled at move-exhaustion, not here)
+        return jnp.bool_(False), jnp.bool_(False), kind
+    if variant == "atomic":
+        adj = (
+            (their_k >= 0) & (our_k >= 0)
+            & jnp.any(jnp.asarray(T.KING_TARGETS)[their_k_c] == our_k)
+        )
+        lost = our_k < 0  # mover exploded our king: mover wins — even if
+        # its own king exploded too (host: _move_is_safe checks the
+        # enemy king first)
+        illegal = ~lost & (
+            (their_k < 0)
+            | (is_attacked(b.board, their_k_c, us) & ~adj)
+        )
+        checked = checked & ~adj  # adjacent kings can never be in check
+        kind = jnp.where(lost, TERM_LOSS, kind)
+        return illegal, checked, kind
+    if variant == "horde":
+        # white is the kingless horde: no check duty/right for white
+        illegal = jnp.where(them == 1, self_check, False)
+        checked = jnp.where(us == 1, checked, False)
+        white_dead = ~jnp.any(piece_color(b.board) == 0)
+        kind = jnp.where((us == 0) & white_dead, TERM_LOSS, kind)
+        return illegal, checked, kind
+    if variant == "kingOfTheHill":
+        hill = (
+            (their_k == 27) | (their_k == 28)
+            | (their_k == 35) | (their_k == 36)
+        )
+        kind = jnp.where(hill, TERM_LOSS, kind)  # mover reached the hill
+        return self_check, checked, kind
+    if variant == "racingKings":
+        our8 = our_k >= 56
+        their8 = their_k >= 56
+        illegal = self_check | checked  # giving check is illegal
+        # white moves first, so black gets one rejoinder: white-on-goal
+        # is only a win once it is white's move again; black-on-goal wins
+        # immediately; both → draw (host: RacingKings._variant_outcome)
+        kind = jnp.where(
+            our8 & their8, TERM_DRAW,
+            jnp.where(
+                their8 & (them == 1), TERM_LOSS,
+                jnp.where(our8 & (us == 0), TERM_WIN, kind),
+            ),
+        )
+        return illegal, jnp.bool_(False), kind
+    if variant == "threeCheck":
+        them_checks = jnp.where(
+            us == 0, b.extra[EXTRA_CHECKS + 1], b.extra[EXTRA_CHECKS + 0]
+        )
+        kind = jnp.where(them_checks >= 3, TERM_LOSS, kind)
+        return self_check, checked, kind
+    return self_check, checked, kind  # standard / chess960 / crazyhouse
+
+
 def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
     """Apply an encoded move (from | to<<6 | promo<<12) to one lane.
 
@@ -192,7 +282,7 @@ def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
     )
 
     # normal placement (promotion replaces the pawn)
-    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
+    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 5)] + 6 * us
     placed = jnp.where(promo > 0, promo_piece, piece)
     if is_drop is not None:
         # dropped piece: promo bits carry the ptype (0..4 = P..Q)
@@ -222,9 +312,44 @@ def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
 
     # new ep square on double pawn push
     dbl = is_pawn & (jnp.abs(to - frm) == 16)
+    if variant == "horde":
+        # back-rank doubles (horde pawns on rank 1) set no ep square
+        dbl &= ~((us == 0) & ((frm >> 3) == 0))
     new_ep = jnp.where(dbl, (frm + to) // 2, -1)
 
     capture = (piece_color(target) == them) | is_ep
+
+    if variant == "atomic":
+        # explosion: a capture removes the capturer and every NON-PAWN
+        # piece within one king-step of the landing square (the captured
+        # piece itself is removed regardless); exploded rook squares lose
+        # their castling rights (host spec: chess/variants.py
+        # AtomicPosition._post_move_hook)
+        zone_sqs = jnp.asarray(T.KING_TARGETS)[to]  # (8,), -1 padded
+        # one-hot compare, not scatter: a clipped -1 pad would write False
+        # over square a1 (nondeterministically vs a real True at duplicate
+        # index 0), letting an a1 piece survive an explosion
+        sq64 = jnp.arange(64, dtype=jnp.int32)
+        in_zone = jnp.any(
+            (sq64[None, :] == zone_sqs[:, None]) & (zone_sqs >= 0)[:, None],
+            axis=0,
+        )
+        in_zone = in_zone | (sq64 == to)
+        exploded = jnp.where(
+            in_zone & (piece_type(out_board) != 0), 0, out_board
+        )
+        # the capturer itself is always removed, pawn or not
+        exploded = exploded.at[to].set(0)
+        out_board = jnp.where(capture, exploded, out_board)
+        cast = jnp.where(
+            capture & (cast >= 0) & in_zone[jnp.clip(cast, 0, 63)], -1, cast
+        )
+        # a side whose king explodes has no castling rights (the device
+        # representation, like from_position, ties rights to a live king)
+        wk_alive = jnp.any(out_board == T.W_KING)
+        bk_alive = jnp.any(out_board == T.B_KING)
+        slot_alive = jnp.where(jnp.arange(4) < 2, wk_alive, bk_alive)
+        cast = jnp.where(capture & ~slot_alive, -1, cast)
     pawnish = is_pawn
     if is_drop is not None:
         # a pawn drop is a pawn move (resets the fifty-move clock)
@@ -332,7 +457,7 @@ def move_piece_changes(b: Board, move: jnp.ndarray, variant: str = "standard"):
     rank_base = jnp.where(us == 0, 0, 56)
     kingside = to > frm
     k_dest = rank_base + jnp.where(kingside, 6, 2)
-    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 4)] + 6 * us
+    promo_piece = jnp.asarray(T.PROMO_TO_PIECE)[jnp.clip(promo, 0, 5)] + 6 * us
     placed = jnp.where(promo > 0, promo_piece, piece)
     if is_drop is not None:
         placed = jnp.where(is_drop, 1 + jnp.clip(promo, 0, 4) + 6 * us, placed)

@@ -28,9 +28,13 @@ from .board import (
 )
 
 MAX_MOVES = T.MAX_MOVES
-# crazyhouse adds up to 5 droppable types × ≤62 empty squares on top of
-# ordinary board moves; its program compiles with a wider move list
-MAX_MOVES_ZH = 384
+# crazyhouse adds up to 5 droppable types × ≤64 empty squares on top of
+# ordinary board moves; its program compiles with a wider move list.
+# 5*64 + MAX_MOVES is a PROVEN bound (drops can never exceed 5 types ×
+# empty squares; board moves are bounded by MAX_MOVES): _compact silently
+# drops overflow, so an unproven cap would be a correctness hole — extra
+# width only costs padding in the crazyhouse program
+MAX_MOVES_ZH = 5 * 64 + MAX_MOVES
 DROP_FLAG = 1 << 15  # move encoding: drops are DROP_FLAG | pt<<12 | to<<6 | to
 
 
@@ -68,7 +72,8 @@ def _capture_key(victim_type: jnp.ndarray, attacker_type: jnp.ndarray,
     return key.astype(jnp.int32)
 
 
-def generate_moves(b: Board, variant: str = "standard"):
+def generate_moves(b: Board, variant: str = "standard",
+                   killers=None, hist=None):
     """→ (moves (max_moves_for(variant),) sorted by ordering key, count (),
     noisy ()).
 
@@ -77,6 +82,11 @@ def generate_moves(b: Board, variant: str = "standard"):
     Moves are encoded from | to<<6 | promo<<12; castling is king-takes-rook.
     `variant` is STATIC (compiled per variant): threeCheck generates like
     standard; crazyhouse appends pocket drops (quiet, after board quiets).
+
+    killers (2,) int32 / hist (4096,) int32: optional quiet-move ordering
+    state (killer slots for this node's ply; from|to-indexed history
+    counters). They reorder only the quiet tail (keys >= 900), so the
+    noisy prefix the quiescence search expands is unaffected.
     """
     board = b.board
     us = b.stm
@@ -90,6 +100,7 @@ def generate_moves(b: Board, variant: str = "standard"):
     all_moves = []
     all_valid = []
     all_keys = []
+    all_iscap = []  # per-candidate capture flags (antichess compulsion)
 
     # ---------------------------------------------------------------- sliders
     rays = jnp.asarray(T.RAYS)  # (64, 8, 7)
@@ -116,6 +127,7 @@ def generate_moves(b: Board, variant: str = "standard"):
     all_moves.append(cands)
     all_valid.append(valid)
     all_keys.append(keys)
+    all_iscap.append(target_enemy & rocc)
 
     # ---------------------------------------------------------- knights, king
     for table, ptype_want in ((T.KNIGHT_TARGETS, 1), (T.KING_TARGETS, 5)):
@@ -129,6 +141,9 @@ def generate_moves(b: Board, variant: str = "standard"):
             & tvalid
             & ~(piece_color(tpiece) == us)
         )
+        if variant == "atomic" and ptype_want == 5:
+            # atomic kings never capture (the capture would explode them)
+            valid &= ~(piece_color(tpiece) == them)
         cands = sq_idx[:, None] | (tsq << 6)
         keys = _capture_key(
             jnp.maximum(piece_type(tpiece), 0),
@@ -139,6 +154,7 @@ def generate_moves(b: Board, variant: str = "standard"):
         all_moves.append(cands)
         all_valid.append(valid)
         all_keys.append(keys)
+        all_iscap.append(piece_color(tpiece) == them)
 
     # ------------------------------------------------------------------ pawns
     fwd = jnp.where(us == 0, 8, -8)
@@ -151,7 +167,11 @@ def generate_moves(b: Board, variant: str = "standard"):
     to1 = jnp.clip(sq_idx + fwd, 0, 63)
     to1_ok = our_pawn & (board[to1] == 0)
     to2 = jnp.clip(sq_idx + 2 * fwd, 0, 63)
-    to2_ok = to1_ok & (ranks == start_rank) & (board[to2] == 0)
+    dbl_rank = ranks == start_rank
+    if variant == "horde":
+        # horde pawns on the back rank may also double-push
+        dbl_rank |= (us == 0) & (ranks == 0)
+    to2_ok = to1_ok & dbl_rank & (board[to2] == 0)
 
     caps = jnp.asarray(T.PAWN_CAPTURES)[us]  # (64, 2)
     cvalid = caps >= 0
@@ -179,22 +199,25 @@ def generate_moves(b: Board, variant: str = "standard"):
     all_moves.append(cands)
     all_valid.append(pawn_ok)
     all_keys.append(keys)
+    all_iscap.append(is_cap)
 
-    # promotions: [push, capL, capR] × 4 promo pieces
+    # promotions: [push, capL, capR] × 4 promo pieces (5 in antichess,
+    # which allows promotion to king)
     promo_tos = jnp.stack([to1, csq[:, 0], csq[:, 1]], axis=1)  # (64, 3)
     promo_ok_base = jnp.stack(
         [to1_ok & pre_promo, cap_ok[:, 0] & pre_promo, cap_ok[:, 1] & pre_promo],
         axis=1,
     )
-    promos = jnp.asarray(
-        [T.PROMO_N, T.PROMO_B, T.PROMO_R, T.PROMO_Q], dtype=jnp.int32
-    )
+    promo_list = [T.PROMO_N, T.PROMO_B, T.PROMO_R, T.PROMO_Q]
+    if variant == "antichess":
+        promo_list.append(T.PROMO_K)
+    promos = jnp.asarray(promo_list, dtype=jnp.int32)
     cands = (
         sq_idx[:, None, None]
         | (promo_tos[:, :, None] << 6)
         | (promos[None, None, :] << 12)
     )
-    valid = promo_ok_base[:, :, None] & jnp.ones((1, 1, 4), bool)
+    valid = promo_ok_base[:, :, None] & jnp.ones((1, 1, len(promo_list)), bool)
     vict = jnp.maximum(piece_type(board[promo_tos]), 0)[:, :, None]
     is_cap = jnp.stack([jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]], axis=1)
     keys = _capture_key(
@@ -206,6 +229,7 @@ def generate_moves(b: Board, variant: str = "standard"):
     all_moves.append(cands)
     all_valid.append(valid)
     all_keys.append(keys)
+    all_iscap.append(jnp.broadcast_to(is_cap[:, :, None], cands.shape))
 
     # --------------------------------------------------------------- castling
     ksq = king_square(board, us)
@@ -248,6 +272,7 @@ def generate_moves(b: Board, variant: str = "standard"):
     all_moves.append(jnp.stack([mv0, mv1]))
     all_valid.append(jnp.stack([ok0, ok1]))
     all_keys.append(jnp.full((2,), 900, dtype=jnp.int32))
+    all_iscap.append(jnp.zeros(2, bool))
 
     # ------------------------------------------------------ crazyhouse drops
     if variant == "crazyhouse":
@@ -268,13 +293,31 @@ def generate_moves(b: Board, variant: str = "standard"):
         all_valid.append(valid)
         # drops search after ordinary quiet moves
         all_keys.append(jnp.full((5, 64), 1100, dtype=jnp.int32))
+        all_iscap.append(jnp.zeros((5, 64), bool))
 
     flat_moves = jnp.concatenate([m.reshape(-1) for m in all_moves])
     flat_valid = jnp.concatenate([v.reshape(-1) for v in all_valid])
     flat_keys = jnp.concatenate([k.reshape(-1) for k in all_keys])
+    if variant == "antichess":
+        # capture compulsion: when any capture exists, ONLY captures are
+        # legal (en-passant counts — cap_ok folded it into is_cap above)
+        flat_iscap = jnp.concatenate([c.reshape(-1) for c in all_iscap])
+        any_cap = jnp.any(flat_valid & flat_iscap)
+        flat_valid &= jnp.where(any_cap, flat_iscap, True)
     moves, keys, count = _compact(
         flat_moves, flat_valid, flat_keys, cap=max_moves_for(variant)
     )
+
+    # quiet-move ordering refinements, applied before the sort:
+    # history first (quiets 1000 → 911..1010, drops 1100 → 1011..1110 by
+    # counter magnitude), then killers jump the whole quiet tail to 901
+    if hist is not None:
+        hbonus = jnp.clip(hist[jnp.clip(moves, 0) & 4095] >> 5, 0, 99)
+        keys = jnp.where(keys == 1000, 1010 - hbonus, keys)
+        keys = jnp.where(keys == 1100, 1110 - hbonus, keys)
+    if killers is not None:
+        is_k = ((moves == killers[0]) | (moves == killers[1])) & (moves >= 0)
+        keys = jnp.where(is_k & (keys >= 900), 901, keys)
 
     # order: stable sort by key so captures/promotions are searched first
     order = jnp.argsort(keys, stable=True)

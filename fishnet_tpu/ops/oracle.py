@@ -26,12 +26,14 @@ import numpy as np
 from ..models import nnue
 from . import tt as tt_mod
 from .board import (
-    EXTRA_CHECKS,
+    TERM_DRAW,
+    TERM_LOSS,
+    TERM_NONE,
+    TERM_WIN,
     Board,
-    is_attacked,
-    king_square,
     make_move,
     move_piece_changes,
+    node_rules,
 )
 from .movegen import generate_moves
 from .search import DRAW, ILLEGAL, INF, MATE
@@ -43,31 +45,26 @@ def _jitted(b768: bool, variant: str):
     dominates the oracle's runtime, so everything per-node is batched into
     `classify`, and per-child into `child`)."""
 
-    def classify(params, b: Board, acc):
+    def classify(params, b: Board, acc, killers, hist):
         us = b.stm
-        them = 1 - us
-        their_k = king_square(b.board, them)
-        illegal = (their_k < 0) | is_attacked(
-            b.board, jnp.maximum(their_k, 0), us
-        )
-        our_k = king_square(b.board, us)
-        checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
-        if b768:
+        illegal, checked, term_kind = node_rules(b, variant)
+        if b768 and variant != "atomic":
             val = jnp.int32(
                 nnue.forward_from_acc(params, acc, us, nnue.output_bucket(b.board))
             )
         else:
+            # atomic explosions exceed the 4-slot incremental scheme —
+            # full refresh, same as the device step
             val = jnp.int32(nnue.evaluate(params, b.board, us))
-        moves, count, noisy = generate_moves(b, variant)
-        h1, h2 = tt_mod.hash_board(b.board, us, b.ep, b.castling, b.extra, variant)
-        them_checks = jnp.where(
-            us == 0, b.extra[EXTRA_CHECKS + 1], b.extra[EXTRA_CHECKS + 0]
+        moves, count, noisy = generate_moves(
+            b, variant, killers=killers, hist=hist
         )
-        return illegal, checked, val, moves, count, noisy, h1, h2, them_checks
+        h1, h2 = tt_mod.hash_board(b.board, us, b.ep, b.castling, b.extra, variant)
+        return illegal, checked, val, moves, count, noisy, h1, h2, term_kind
 
     def child(params, b: Board, acc, move):
         nb = make_move(b, move, variant)
-        if b768:
+        if b768 and variant != "atomic":
             codes, sqs, signs = move_piece_changes(b, move, variant)
             nacc = nnue.apply_acc_updates_768(params, acc, codes, sqs, signs)
         else:
@@ -83,7 +80,7 @@ def _jitted(b768: bool, variant: str):
 
 class _Oracle:
     def __init__(self, params, depth: int, node_budget: int, max_ply: int,
-                 variant: str = "standard"):
+                 variant: str = "standard", history=None):
         self.p = params
         self.depth = depth
         self.budget = node_budget
@@ -93,12 +90,29 @@ class _Oracle:
         self.rep_hits = 0  # repetition-draw leaves seen (test instrumentation)
         self.b768 = nnue.is_board768(params)
         self.ops = _jitted(self.b768, variant)
-        self.path = []  # [(h1, h2, halfmove)] of entered path nodes
+        # [(h1, h2, halfmove, virtual_ply)]: pre-root game history at
+        # virtual ply -distance (mirrors ops/search.py hist_hash slots),
+        # then entered in-search path nodes at their real plies.
+        # history: [(h1, h2, halfmove, distance)] with distance >= 1
+        # plies before the root — pre-filtered to doubled positions the
+        # same way the engine seeds the device (see engine/tpu.py
+        # _history_arrays).
+        self.path = [
+            (h1, h2, hm, -dist) for h1, h2, hm, dist in (history or [])
+        ]
+        # quiet-move ordering state, mirroring the device lane's exactly
+        # (ops/search.py killer/history update on fail-high)
+        self.killers = np.full((max_ply + 2, 2), -1, np.int32)
+        self.hist = np.zeros(4096, np.int32)
 
     def search(self, b: Board, acc, ply: int, alpha: int, beta: int) -> int:
         ops = self.ops
         (illegal, checked, val, moves, count, noisy, h1, h2,
-         them_checks) = ops["classify"](self.p, b, acc)
+         term_kind) = ops["classify"](
+            self.p, b, acc,
+            jnp.asarray(self.killers[min(ply, self.max_ply)]),
+            jnp.asarray(self.hist),
+        )
         if ply > 0 and bool(illegal):
             return ILLEGAL
         depth_left = self.depth - ply
@@ -110,8 +124,8 @@ class _Oracle:
         # equal hash through an unbroken reversible chain
         hh = (int(h1), int(h2))
         repet = any(
-            (halfmove - ph) == (ply - k) and (a, c) == hh
-            for k, (a, c, ph) in enumerate(self.path)
+            (halfmove - ph) == (ply - vp) and (a, c) == hh
+            for a, c, ph, vp in self.path
         )
         self.rep_hits += int(repet)
         in_qs = depth_left <= 0
@@ -120,12 +134,17 @@ class _Oracle:
         leaf_val = DRAW if (fifty or repet) else max(
             min(int(val), MATE - 1000), -(MATE - 1000)
         )
-        three = self.variant == "threeCheck" and int(them_checks) >= 3
-        if three:
-            leaf_val = -(MATE - ply)
+        kind = int(term_kind)
+        vterm = kind != TERM_NONE
+        if vterm:
+            leaf_val = {
+                TERM_LOSS: -(MATE - ply),
+                TERM_WIN: MATE - ply,
+                TERM_DRAW: DRAW,
+            }[kind]
         count, noisy = int(count), int(noisy)
         is_leaf = (
-            fifty or repet or three or over_budget or stack_full
+            fifty or repet or vterm or over_budget or stack_full
             or (in_qs and noisy == 0)
         )
         if in_qs and leaf_val >= beta:  # stand-pat beta cutoff
@@ -142,7 +161,9 @@ class _Oracle:
             best = -INF
         searched = 0
         cut = False
-        self.path.append((hh[0], hh[1], halfmove))
+        best_move = -1
+        board_np = np.asarray(b.board)
+        self.path.append((hh[0], hh[1], halfmove, ply))
         try:
             for i in range(n):
                 if alpha >= beta:
@@ -156,23 +177,49 @@ class _Oracle:
                 searched += 1
                 if -v > best:
                     best = -v
+                    best_move = mv
                 alpha = max(alpha, best)
+            # killer/history credit on fail-high, mirroring the device's
+            # TRYMOVE update bit for bit (which also fires when the
+            # cutoff move happened to be the last one generated)
+            if alpha >= beta and best_move >= 0:
+                cause = best_move
+                cto = (cause >> 6) & 63
+                quiet = ((cause >> 15) & 1) == 1 or (
+                    int(board_np[cto]) == 0 and ((cause >> 12) & 7) == 0
+                )
+                if quiet:
+                    kp = min(ply, self.max_ply)
+                    k0 = int(self.killers[kp, 0])
+                    if cause != k0:
+                        self.killers[kp] = (cause, k0)
+                    dl = max(self.depth - ply, 0)
+                    w = min(dl * dl + 1, 1024)
+                    idx = cause & 4095
+                    self.hist[idx] = min(int(self.hist[idx]) + w, 1 << 20)
         finally:
             self.path.pop()
         if searched == 0 and not in_qs and not cut:
+            if self.variant == "antichess":
+                # the side with no moves (stalemated / out of pieces) WINS
+                return MATE - ply
             return -(MATE - ply) if bool(checked) else DRAW
         return best
 
 
 def oracle_search(params, root: Board, depth: int, node_budget: int,
-                  max_ply: int, variant: str = "standard") -> dict:
+                  max_ply: int, variant: str = "standard",
+                  history=None) -> dict:
     """Search one root exactly like one device lane; → {score, nodes}.
 
     root: single-lane Board. Matches ops.search.search_batch semantics for
     the same (depth, node_budget, max_ply, variant); scores must agree
-    exactly.
+    exactly. history: optional [(h1, h2, halfmove, distance)] doubled
+    positions from the reversible game tail, distance = plies before the
+    root (mirrors the device's hist_hash/hist_halfmove seeding; see
+    engine/tpu.py _history_arrays for the Stockfish draw-rule rationale).
     """
-    o = _Oracle(params, depth, node_budget, max_ply, variant)
+    o = _Oracle(params, depth, node_budget, max_ply, variant, history)
     if o.b768:
         acc = o.ops["acc_root"](params, root.board)
     else:

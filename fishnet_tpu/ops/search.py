@@ -31,12 +31,13 @@ import numpy as np
 
 from ..models import nnue
 from .board import (
-    EXTRA_CHECKS,
+    TERM_LOSS,
+    TERM_NONE,
+    TERM_WIN,
     Board,
-    is_attacked,
-    king_square,
     make_move,
     move_piece_changes,
+    node_rules,
 )
 from .movegen import MAX_MOVES, generate_moves, max_moves_for
 from . import tt as _tt_mod
@@ -51,6 +52,15 @@ MODE_RETURN = 1
 MODE_TRYMOVE = 2
 MODE_DONE = 3
 
+# game-history repetition seeding: hashes of up to MAX_HIST reversible
+# game positions before each lane's root (the reference feeds Stockfish
+# the full `position fen ... moves ...` history, so repetitions against
+# already-played positions score as draws — src/stockfish.rs:298-306).
+# Slot MAX_HIST-1 is the root's parent; unused slots carry the sentinel
+# halfmove, which can never satisfy the reversible-chain condition.
+MAX_HIST = 16
+HIST_HM_SENTINEL = -32000
+
 
 class SearchState(NamedTuple):
     # stacks, leading dims (B, MAX_PLY[+1])
@@ -61,9 +71,13 @@ class SearchState(NamedTuple):
     halfmove: jnp.ndarray  # (B, P+1)
     extra: jnp.ndarray  # (B, P+1, 12) variant side-state (board.EXTRA_*)
     phash: jnp.ndarray  # (B, P+1, 2) uint32 path hashes (repetition scan)
+    hist_hash: jnp.ndarray  # (B, MAX_HIST, 2) uint32 pre-root game hashes
+    hist_halfmove: jnp.ndarray  # (B, MAX_HIST) their halfmove counters
     moves: jnp.ndarray  # (B, P, MAX_MOVES) int32
     count: jnp.ndarray  # (B, P)
     midx: jnp.ndarray  # (B, P)
+    killers: jnp.ndarray  # (B, P, 2) killer-move slots per ply (-1 empty)
+    hist: jnp.ndarray  # (B, 4096) from|to-indexed history counters
     searched: jnp.ndarray  # (B, P) legal children folded so far
     alpha: jnp.ndarray  # (B, P) int32
     alpha0: jnp.ndarray  # (B, P) window lower bound at entry (for TT flags)
@@ -88,6 +102,8 @@ class SearchState(NamedTuple):
     node_budget: jnp.ndarray  # (B,)
     root_score: jnp.ndarray  # (B,)
     root_move: jnp.ndarray  # (B,)
+    root_alpha: jnp.ndarray  # (B,) aspiration window at the root
+    root_beta: jnp.ndarray  # (B,)
 
 
 def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
@@ -103,8 +119,16 @@ def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
 
 def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
                node_budget: jnp.ndarray, max_ply: int,
-               variant: str = "standard") -> SearchState:
-    """roots: batched Board (B leading dim); depth/node_budget: (B,)."""
+               variant: str = "standard",
+               hist_hash=None, hist_halfmove=None,
+               root_alpha=None, root_beta=None) -> SearchState:
+    """roots: batched Board (B leading dim); depth/node_budget: (B,).
+
+    hist_hash (B, MAX_HIST, 2) / hist_halfmove (B, MAX_HIST): optional
+    reversible game-history tail per lane (see MAX_HIST above); None
+    seeds the sentinel (no pre-root repetitions possible).
+    root_alpha/root_beta (B,): optional aspiration window at the root
+    (host-side iterative deepening re-searches on fail-low/high)."""
     B = roots.stm.shape[0]
     P = max_ply
     l1 = params.ft_w.shape[1]
@@ -114,8 +138,10 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         )
     else:
         root_acc = jnp.zeros((B, 2, l1), params.ft_w.dtype)
-    acc = jnp.zeros((B, P + 1, 2, l1), params.ft_w.dtype)
-    acc = acc.at[:, 0].set(root_acc)
+    # acc stays f32 even under bf16-quantized weights (nnue.cast_params):
+    # incremental adds accumulate rounding error down the stack otherwise
+    acc = jnp.zeros((B, P + 1, 2, l1), jnp.float32)
+    acc = acc.at[:, 0].set(root_acc.astype(jnp.float32))
 
     def z(*shape, dtype=jnp.int32, fill=0):
         return jnp.full((B, *shape), fill, dtype=dtype)
@@ -133,11 +159,19 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
     extra = z(P + 1, 12)
     extra = extra.at[:, 0].set(roots.extra)
     phash = jnp.zeros((B, P + 1, 2), jnp.uint32)
+    if hist_hash is None:
+        hist_hash = jnp.zeros((B, MAX_HIST, 2), jnp.uint32)
+    if hist_halfmove is None:
+        hist_halfmove = jnp.full((B, MAX_HIST), HIST_HM_SENTINEL, jnp.int32)
     return SearchState(
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
         extra=extra, phash=phash,
+        hist_hash=jnp.asarray(hist_hash, jnp.uint32),
+        hist_halfmove=jnp.asarray(hist_halfmove, jnp.int32),
         moves=z(P, max_moves_for(variant), fill=-1),
-        count=z(P), midx=z(P), searched=z(P),
+        count=z(P), midx=z(P),
+        killers=z(P, 2, fill=-1), hist=z(4096),
+        searched=z(P),
         alpha=z(P, fill=-INF), alpha0=z(P, fill=-INF), beta=z(P, fill=INF),
         best=z(P, fill=-INF), best_move=z(P, fill=-1),
         incheck=z(P, dtype=jnp.bool_),
@@ -149,6 +183,14 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         depth_limit=depth.astype(jnp.int32),
         node_budget=node_budget.astype(jnp.int32),
         root_score=z(fill=-INF), root_move=z(fill=-1),
+        root_alpha=(
+            jnp.full((B,), -INF, jnp.int32) if root_alpha is None
+            else jnp.asarray(root_alpha, jnp.int32)
+        ),
+        root_beta=(
+            jnp.full((B,), INF, jnp.int32) if root_beta is None
+            else jnp.asarray(root_beta, jnp.int32)
+        ),
     )
 
 
@@ -171,15 +213,10 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
 
     b = _board_at(s, ply)
     us = b.stm
-    them = 1 - us
-    our_k = king_square(b.board, us)
-    their_k = king_square(b.board, them)
-    # parent's move was illegal iff the side that just moved (them)
-    # left its king attacked (or captured outright)
-    parent_illegal = (ply > 0) & (
-        (their_k < 0) | is_attacked(b.board, jnp.maximum(their_k, 0), us)
-    )
-    we_are_checked = is_attacked(b.board, jnp.maximum(our_k, 0), them)
+    # legality of the move that led here + check state + variant-rule
+    # game end, all per the statically compiled variant (board.node_rules)
+    illegal_raw, we_are_checked, term_kind = node_rules(b, variant)
+    parent_illegal = (ply > 0) & illegal_raw
     depth_left = s.depth_limit - ply
     over_budget = s.nodes >= s.node_budget
     fifty = b.halfmove >= 100
@@ -202,12 +239,23 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     )
     ks = jnp.arange(s.phash.shape[0], dtype=jnp.int32)
     chain_ok = (b.halfmove - s.halfmove[ks]) == (ply - ks)
-    repet = enter & jnp.any(
+    repet_path = jnp.any(
         (ks < ply)
         & chain_ok
         & (s.phash[:, 0] == h1)
         & (s.phash[:, 1] == h2)
     )
+    # ... and against the pre-root game history: slot k sits at virtual
+    # ply k - MAX_HIST, so the unbroken-reversible-chain condition is
+    # halfmove distance == ply distance with that offset
+    hk = jnp.arange(s.hist_halfmove.shape[0], dtype=jnp.int32)
+    hist_chain = (b.halfmove - s.hist_halfmove) == (
+        ply + (s.hist_halfmove.shape[0] - hk)
+    )
+    repet_hist = jnp.any(
+        hist_chain & (s.hist_hash[:, 0] == h1) & (s.hist_hash[:, 1] == h2)
+    )
+    repet = enter & (repet_path | repet_hist)
     # quiescence: past the nominal depth, keep expanding CAPTURES until
     # the position is quiet (gen_noisy == 0), the stack is full, or the
     # budget runs out — the standard horizon-effect fix, with stand-pat
@@ -218,8 +266,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
     # path the accumulator came down the stack incrementally and only the
     # small layer stack runs here; the halfkav2_hm compat path pays a full
-    # refresh per step.
-    if nnue.is_board768(params):
+    # refresh per step — as does atomic, whose explosions exceed the
+    # 4-slot incremental update scheme (move_piece_changes).
+    if nnue.is_board768(params) and variant != "atomic":
         leaf_val = jnp.int32(
             nnue.forward_from_acc(params, s.acc[ply], us, nnue.output_bucket(b.board))
         )
@@ -228,25 +277,33 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
     leaf_val = jnp.where(fifty | repet, DRAW, leaf_val)
 
-    # threeCheck: the opponent completing 3 checks ends the game at once
-    # (takes precedence over draws; mate-range value, so never TT-stored)
-    three = jnp.bool_(False)
-    if variant == "threeCheck":
-        them_checks = jnp.where(
-            us == 0, b.extra[EXTRA_CHECKS + 1], b.extra[EXTRA_CHECKS + 0]
-        )
-        three = them_checks >= 3
-        leaf_val = jnp.where(three, -(MATE - ply), leaf_val)
+    # variant-rule game end (3 checks, exploded king, hill, goal rank,
+    # horde destroyed) ends the node at once — takes precedence over
+    # draws; mate-range (or rule-draw) values are never TT-stored
+    vterm = term_kind != TERM_NONE
+    leaf_val = jnp.where(
+        vterm,
+        jnp.where(
+            term_kind == TERM_LOSS, -(MATE - ply),
+            jnp.where(term_kind == TERM_WIN, MATE - ply, DRAW),
+        ),
+        leaf_val,
+    )
 
-    gen_moves, gen_count, gen_noisy = generate_moves(b, variant)
+    gen_moves, gen_count, gen_noisy = generate_moves(
+        b, variant,
+        killers=s.killers[jnp.minimum(ply, s.killers.shape[0] - 1)],
+        hist=s.hist,
+    )
     is_leaf = (
-        fifty | repet | three | over_budget | stack_full
+        fifty | repet | vterm | over_budget | stack_full
         | (in_qs & (gen_noisy == 0))
     )
     # stand-pat beta cutoff: in QS the static eval is already >= beta —
     # the opponent wouldn't enter this line; fail high immediately
     stand_pat_cut = in_qs & (
-        leaf_val >= jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)])
+        leaf_val
+        >= jnp.where(ply == 0, s.root_beta, -s.alpha[jnp.maximum(ply - 1, 0)])
     )
     is_leaf |= stand_pat_cut
 
@@ -255,7 +312,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # repetition draws — the hash excludes the halfmove counter and the
     # path, so a stored score must not override a forced draw)
     use_tt = (
-        (tt_hit & (ply > 0) & ~fifty & ~repet & ~three)
+        (tt_hit & (ply > 0) & ~fifty & ~repet & ~vterm)
         if tt_hit is not None
         else jnp.bool_(False)
     )
@@ -296,7 +353,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     count = row_upd(s.count, jnp.where(in_qs, gen_noisy, gen_count), expand)
     midx = row_upd(s.midx, 0, expand)
     searched = row_upd(s.searched, 0, expand)
-    entry_alpha = jnp.where(ply == 0, -INF, -s.beta[jnp.maximum(ply - 1, 0)])
+    entry_alpha = jnp.where(
+        ply == 0, s.root_alpha, -s.beta[jnp.maximum(ply - 1, 0)]
+    )
     # stand-pat: in QS the node may decline every capture and keep the
     # static eval, so it floors both best and alpha
     qs_floor = in_qs & expand
@@ -307,7 +366,9 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     )
     alpha0 = row_upd(s.alpha0, entry_alpha, expand)
     beta = row_upd(
-        s.beta, jnp.where(ply == 0, INF, -s.alpha[jnp.maximum(ply - 1, 0)]), expand
+        s.beta,
+        jnp.where(ply == 0, s.root_beta, -s.alpha[jnp.maximum(ply - 1, 0)]),
+        expand,
     )
     best = row_upd(s.best, jnp.where(qs_floor, leaf_val, -INF), expand)
     best_move = row_upd(s.best_move, -1, expand)
@@ -383,12 +444,40 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     finish = exhausted | cutoff
     advance = try_m & ~finish
 
+    # killer/history credit on fail-high: the quiet move that raised
+    # alpha >= beta becomes killer slot 0 for this ply and earns a
+    # depth²-weighted history bump (captures already order by MVV-LVA;
+    # en-passant reads as quiet here, which only costs ordering)
+    cause = best_move[ply]
+    cto = jnp.clip((cause >> 6) & 63, 0, 63)
+    c_quiet = (cause >= 0) & (
+        (((cause >> 15) & 1) == 1)  # drops are quiet by construction
+        | ((s.board[ply][cto] == 0) & (((cause >> 12) & 7) == 0))
+    )
+    k_upd = try_m & cutoff & c_quiet
+    k0 = s.killers[ply, 0]
+    new_row = jnp.stack([cause, jnp.where(cause == k0, s.killers[ply, 1], k0)])
+    killers = s.killers.at[ply].set(
+        jnp.where(k_upd & (cause != k0), new_row, s.killers[ply])
+    )
+    h_idx = jnp.clip(cause, 0) & 4095
+    dl = jnp.maximum(s.depth_limit - ply, 0)
+    h_w = jnp.where(k_upd, jnp.minimum(dl * dl + 1, 1024), 0)
+    hist = s.hist.at[h_idx].set(
+        jnp.minimum(s.hist[h_idx] + h_w, 1 << 20)
+    )
+
     # finished node value: best, or mate/stalemate when no legal child.
     # QS nodes only tried captures — no legal capture is NOT mate; their
     # stand-pat floor in `best` already covers the quiet alternatives.
     node_in_qs = (s.depth_limit - ply) <= 0
     no_legal = (searched[ply] == 0) & ~node_in_qs
-    mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
+    if variant == "antichess":
+        # losing chess: the side with no moves left (stalemated or out of
+        # pieces) WINS (host: AntichessPosition._variant_outcome)
+        mate_val = MATE - ply
+    else:
+        mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
     fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
 
     move = moves[ply, jnp.minimum(midx[ply], moves.shape[-1] - 1)]
@@ -413,7 +502,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     extra_st = s.extra.at[nply].set(
         jnp.where(advance, child.extra, s.extra[nply])
     )
-    if nnue.is_board768(params):
+    if nnue.is_board768(params) and variant != "atomic":
         codes, sqs, signs = move_piece_changes(
             parent_b, jnp.maximum(move, 0), variant
         )
@@ -434,13 +523,17 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     return SearchState(
         board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
         extra=extra_st, phash=phash,
-        moves=moves, count=count, midx=midx, searched=searched,
+        hist_hash=s.hist_hash, hist_halfmove=s.hist_halfmove,
+        moves=moves, count=count, midx=midx,
+        killers=killers, hist=hist,
+        searched=searched,
         alpha=alpha, alpha0=alpha0, beta=beta, best=best, best_move=best_move,
         incheck=incheck, pv=pv, pv_len=pv_len, acc=acc,
         ply=ply, mode=mode, ret=ret, ret_depth=ret_depth,
         store_mark=store_mark, store_val=store_val, nodes=nodes,
         depth_limit=s.depth_limit, node_budget=s.node_budget,
         root_score=root_score, root_move=root_move,
+        root_alpha=s.root_alpha, root_beta=s.root_beta,
     )
 
 
@@ -545,8 +638,12 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             # ---- probe lanes about to enter a node (mode == ENTER)
             enter = s.mode == MODE_ENTER
             parent = jnp.maximum(s.ply - 1, 0)
-            a_w = jnp.where(s.ply == 0, -INF, -_gather_ply(s.beta, parent))
-            b_w = jnp.where(s.ply == 0, INF, -_gather_ply(s.alpha, parent))
+            a_w = jnp.where(
+                s.ply == 0, s.root_alpha, -_gather_ply(s.beta, parent)
+            )
+            b_w = jnp.where(
+                s.ply == 0, s.root_beta, -_gather_ply(s.alpha, parent)
+            )
             usable, score, _mv, order_mv = _tt_mod.probe(
                 t, h1, h2, s.depth_limit - s.ply, a_w, b_w
             )
@@ -604,8 +701,14 @@ def search_batch_resumable(
     tt=None,
     mesh=None,
     variant: str = "standard",
+    hist=None,
+    window=None,
 ):
     """Like `search_batch`, but dispatched in bounded segments.
+
+    window: optional (root_alpha (B,), root_beta (B,)) aspiration window;
+    a root whose true value falls outside reports a bound (fail-low /
+    fail-high) — the caller re-searches with a wider window.
 
     deadline: absolute time.monotonic() stamp; between segments the host
     stops early when passed. Lanes not DONE at stop report done=False and
@@ -625,7 +728,13 @@ def search_batch_resumable(
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
     node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    state = _init_state_jit(params, roots, depth, node_budget, max_ply, variant)
+    hist_hash, hist_halfmove = hist if hist is not None else (None, None)
+    root_alpha, root_beta = window if window is not None else (None, None)
+    state = _init_state_jit(
+        params, roots, depth, node_budget, max_ply, variant,
+        hist_hash=hist_hash, hist_halfmove=hist_halfmove,
+        root_alpha=root_alpha, root_beta=root_beta,
+    )
     if mesh is not None:
         from ..parallel.mesh import run_segment_sharded
 
@@ -660,7 +769,7 @@ def search_batch_resumable(
 
 def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
                  max_ply: int, max_steps: int = 2_000_000, tt=None,
-                 variant: str = "standard"):
+                 variant: str = "standard", hist=None):
     """Run fixed-depth alpha-beta + capture quiescence on B roots in
     lockstep.
 
@@ -670,17 +779,18 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     headroom. Returns a dict of (B,)-shaped results; scores are
     centipawn ints from the root side to move's perspective; ±(MATE-n)
     encodes mate in n plies. tt: optional shared ops.tt.TTable.
+
+    Thin wrapper over `search_batch_resumable` (one compile surface —
+    tests and production share the same `_run_segment_jit` programs; a
+    second whole-search jit used to double every suite's compile cost).
     """
-    B = roots.stm.shape[0]
-    depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
-    node_budget = jnp.broadcast_to(jnp.asarray(node_budget, jnp.int32), (B,))
-    state = init_state(params, roots, depth, node_budget, max_ply, variant)
-    state, tt, steps = _run_segment(params, state, tt, max_steps, variant)
-    out = extract_results(state, steps)
-    out["tt"] = tt
-    return out
+    return search_batch_resumable(
+        params, roots, depth, node_budget, max_ply=max_ply,
+        segment_steps=min(max_steps, 20_000), max_steps=max_steps,
+        tt=tt, variant=variant, hist=hist,
+    )
 
 
-search_batch_jit = jax.jit(
-    search_batch, static_argnames=("max_ply", "max_steps", "variant")
-)
+# alias kept for callers that used the jitted entry point; the segment
+# dispatch inside is jitted, so a separate outer jit adds nothing
+search_batch_jit = search_batch

@@ -72,9 +72,10 @@ for d in range(8):
         if (d in ROOK_DIR_IDS and is_rook_like) or (d in BISHOP_DIR_IDS and is_bishop_like):
             SLIDER_MASK[d, code] = True
 
-# move encoding: from | to<<6 | promo<<12 (promo 0 none, 1-4 = N B R Q)
-PROMO_NONE, PROMO_N, PROMO_B, PROMO_R, PROMO_Q = 0, 1, 2, 3, 4
-PROMO_TO_PIECE = np.array([0, 2, 3, 4, 5], dtype=np.int32)  # white codes; +6 black
+# move encoding: from | to<<6 | promo<<12 (promo 0 none, 1-4 = N B R Q,
+# 5 = K — antichess promotes to king)
+PROMO_NONE, PROMO_N, PROMO_B, PROMO_R, PROMO_Q, PROMO_K = 0, 1, 2, 3, 4, 5
+PROMO_TO_PIECE = np.array([0, 2, 3, 4, 5, 6], dtype=np.int32)  # white codes; +6 black
 
 MAX_MOVES = 224  # fixed per-ply move-list capacity (max legal known is 218)
 

@@ -47,9 +47,26 @@ _STM_OFF = _CASTLE_OFF + 4 * 65
 _POCKET_OFF = _STM_OFF + 2  # 10 slots × counts 0..16
 _CHECKS_OFF = _POCKET_OFF + 10 * 17  # 2 colors × 0..3 checks
 _PROMOTED_OFF = _CHECKS_OFF + 2 * 4  # 64 promoted-square bits
-_Z_SHAPE = _PROMOTED_OFF + 64
+_VARIANT_OFF = _PROMOTED_OFF + 64  # per-variant salt (shared-table safety)
+_Z_SHAPE = _VARIANT_OFF + 8
+# identical boards under different rule sets must never share a TT entry
+# (the engine keeps ONE table across all chunks) — each variant XORs a
+# fixed salt into the key. threeCheck/crazyhouse extras already perturb
+# the hash, but the rule-mask variants have no extra state to do it.
+_VARIANT_ID = {
+    "standard": 0, "threeCheck": 1, "crazyhouse": 2, "antichess": 3,
+    "atomic": 4, "horde": 5, "kingOfTheHill": 6, "racingKings": 7,
+}
 Z1 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
 Z2 = jnp.asarray(_rng.integers(0, 2**32, _Z_SHAPE, dtype=np.uint32))
+
+
+def hash_boards(boards, variant: str = "standard"):
+    """Batched `hash_board` over a stacked Board (N leading dim) —
+    used by the engine to hash game-history tails in one dispatch."""
+    return jax.vmap(
+        lambda b, s, e, c, x: hash_board(b, s, e, c, x, variant)
+    )(boards.board, boards.stm, boards.ep, boards.castling, boards.extra)
 
 
 class TTable(NamedTuple):
@@ -93,6 +110,9 @@ def hash_board(board64, stm, ep, castling, extra=None, variant: str = "standard"
         for i in range(4):
             h ^= z[_CASTLE_OFF + i * 65 + castling[..., i] + 1]
         h ^= z[_STM_OFF + stm]
+        vid = _VARIANT_ID.get(variant, 0)
+        if vid:
+            h ^= z[_VARIANT_OFF + vid]
         if variant == "threeCheck":
             for c in (0, 1):
                 h ^= z[_CHECKS_OFF + c * 4 + jnp.clip(extra[..., c], 0, 3)]

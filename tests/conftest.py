@@ -18,6 +18,10 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# small engine search stack + one warmup bucket: the MAX_PLY=24 production
+# program takes minutes to compile on XLA:CPU; engine tests search depth ≤3
+os.environ.setdefault("FISHNET_TPU_MAX_PLY", "8")
+os.environ.setdefault("FISHNET_TPU_WARMUP_BUCKETS", "16")
 
 try:
     import jax

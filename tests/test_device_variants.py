@@ -44,7 +44,13 @@ def params():
     )
 
 
-@pytest.fixture(scope="module", params=["crazyhouse", "threeCheck"])
+ALL_VARIANTS = [
+    "crazyhouse", "threeCheck", "antichess", "atomic", "horde",
+    "kingOfTheHill", "racingKings",
+]
+
+
+@pytest.fixture(scope="module", params=ALL_VARIANTS)
 def variant(request):
     return request.param
 
@@ -79,7 +85,14 @@ def test_playouts_match_host(variant, kernels):
             legal = pos.legal_moves()
             if not legal or pos.outcome() is not None:
                 break
-            host_set = {encode_host_move(m) for m in pos.generate_pseudo_legal()}
+            if variant == "antichess":
+                # the device folds capture compulsion into generation
+                # (no check concept, so legal == compulsion-filtered)
+                host_set = {encode_host_move(m) for m in legal}
+            else:
+                host_set = {
+                    encode_host_move(m) for m in pos.generate_pseudo_legal()
+                }
             b = from_position(pos)
             moves, count, _ = gen(b)
             dev_set = set(np.asarray(moves)[: int(count)].tolist())
@@ -115,9 +128,8 @@ def _variant_fens(variant, n, seed=11):
     return fens
 
 
-@pytest.mark.parametrize("depth", [1, 2])
-def test_search_matches_oracle(params, variant, depth):
-    fens = _variant_fens(variant, 8)
+def _oracle_check(params, variant, depth, n_fens=8):
+    fens = _variant_fens(variant, n_fens)
     roots = stack_boards([from_position(from_fen(f, variant)) for f in fens])
     out = search_batch_jit(
         params, roots, depth, 100_000, max_ply=4, variant=variant
@@ -130,6 +142,15 @@ def test_search_matches_oracle(params, variant, depth):
         )
         assert int(out["score"][i]) == exp["score"], (variant, fen, depth)
         assert int(out["nodes"][i]) == exp["nodes"], (variant, fen, depth)
+
+
+def test_search_matches_oracle_depth1(params, variant):
+    _oracle_check(params, variant, 1)
+
+
+@pytest.mark.slow
+def test_search_matches_oracle_depth2(params, variant):
+    _oracle_check(params, variant, 2)
 
 
 def test_three_check_win_is_mate_scored(params):
@@ -147,6 +168,93 @@ def test_three_check_win_is_mate_scored(params):
     )
     score = int(np.asarray(out["score"])[0])
     assert score >= MATE - 10, f"expected 3check win, got {score}"
+
+
+def _spot_score(params, fen, variant, depth=2, lanes=8):
+    root = from_position(from_fen(fen, variant))
+    roots = stack_boards([root] * lanes)
+    out = search_batch_jit(
+        params, roots, depth, 100_000, max_ply=4, variant=variant
+    )
+    return int(np.asarray(out["score"])[0])
+
+
+def test_atomic_exploding_the_king_wins(params):
+    from fishnet_tpu.ops.search import MATE
+
+    # Qxd8 explodes the knight; the blast removes the adjacent king
+    score = _spot_score(params, "3nk3/8/8/8/8/8/8/3QK3 w - - 0 1", "atomic")
+    assert score >= MATE - 10, score
+
+
+def test_atomic_explosion_reaches_a1(params):
+    """Regression: the blast zone must cover square a1 (a clipped -1 pad
+    in KING_TARGETS once overwrote a1's membership), so a non-pawn on a1
+    dies when a capture lands next to it."""
+    pos = from_fen("4k3/8/8/8/8/8/1r6/nR2K3 w - - 0 1", "atomic")
+    mv = next(m for m in pos.legal_moves() if m.uci() == "b1b2")
+    child = pos.push(mv)
+    dev = jax.jit(lambda b, m: make_move(b, m, "atomic"))(
+        from_position(pos), encode_host_move(mv)
+    )
+    assert _boards_equal(dev, from_position(child))
+    assert int(np.asarray(dev.board)[0]) == 0  # the a1 knight exploded
+
+
+def test_koth_reaching_the_hill_wins(params):
+    from fishnet_tpu.ops.search import MATE
+
+    # Kd3-d4 steps onto the hill
+    score = _spot_score(params, "7k/8/8/8/8/3K4/8/8 w - - 0 1", "kingOfTheHill")
+    assert score >= MATE - 10, score
+
+
+def test_racing_kings_goal_with_failed_rejoinder_wins(params):
+    from fishnet_tpu.ops.search import MATE
+
+    # Kg7-g8 reaches the goal; the black king on a1 cannot answer in one
+    score = _spot_score(params, "8/6K1/8/8/8/8/8/k7 w - - 0 1", "racingKings")
+    assert score >= MATE - 10, score
+
+
+def test_racing_kings_rejoinder_draws(params):
+    # white already on the goal, black to move one step below: Ka8
+    # equalizes (draw); every other reply loses — so black scores 0
+    score = _spot_score(params, "6K1/k7/8/8/8/8/8/8 b - - 0 1", "racingKings")
+    assert score == 0, score
+
+
+def test_horde_destroying_the_horde_wins(params):
+    from fishnet_tpu.ops.search import MATE
+
+    # black queen takes white's last pawn → horde destroyed
+    score = _spot_score(params, "4k3/8/8/8/8/8/q6P/8 b - - 0 1", "horde")
+    assert score >= MATE - 10, score
+
+
+def test_antichess_capture_compulsion(params):
+    # white pawn e4 can capture d5: ONLY captures may be generated
+    pos = from_fen(
+        "rnbqkbnr/ppp1pppp/8/3p4/4P3/8/PPPP1PPP/RNBQKBNR w - - 0 2",
+        "antichess",
+    )
+    moves, count, _ = jax.jit(
+        lambda b: generate_moves(b, "antichess")
+    )(from_position(pos))
+    dev = set(np.asarray(moves)[: int(count)].tolist())
+    assert dev == {encode_host_move(m) for m in pos.legal_moves()}
+    assert len(dev) == 1  # exd5 is the only legal move
+
+
+def test_antichess_running_out_of_pieces_wins(params):
+    from fishnet_tpu.ops.search import MATE
+
+    # white's lone pawn must capture (compulsion) and is then taken:
+    # white runs out of pieces and WINS
+    score = _spot_score(
+        params, "8/8/8/8/2q5/3q4/2P5/8 w - - 0 1", "antichess", depth=3
+    )
+    assert score >= MATE - 10, score
 
 
 def test_variant_chunk_through_engine(variant):

@@ -36,6 +36,38 @@ def test_device_matches_reference(params):
         assert abs(got - want) < 0.5, fen
 
 
+def test_bf16_quantization_tolerance(params):
+    """bf16-cast weights must evaluate within a few centipawns of the f32
+    master (SURVEY §7.2 quantization, validated with int tolerance)."""
+    q = nnue.cast_params(params, jnp.bfloat16)
+    assert q.ft_w.dtype == jnp.bfloat16
+    ev = jax.jit(nnue.evaluate)
+    for fen in FENS:
+        b = from_position(Position.from_fen(fen))
+        f32 = float(ev(params, b.board, b.stm))
+        bf16 = float(ev(q, b.board, b.stm))
+        assert abs(f32 - bf16) <= 8.0, (fen, f32, bf16)
+
+
+def test_bf16_search_runs_and_stays_close(params):
+    """A depth-2 search under bf16 weights completes and scores within
+    quantization tolerance of the f32 search."""
+    if not nnue.is_board768(params):
+        pytest.skip("search fast path")
+    from fishnet_tpu.ops.board import stack_boards
+    from fishnet_tpu.ops.search import search_batch_jit
+
+    boards = [from_position(Position.from_fen(f)) for f in FENS]
+    roots = stack_boards(boards * 4)  # 16 lanes, the shared test shape
+    q = nnue.cast_params(params, jnp.bfloat16)
+    a = search_batch_jit(params, roots, 2, 50_000, max_ply=4)
+    b = search_batch_jit(q, roots, 2, 50_000, max_ply=4)
+    sa = np.asarray(a["score"])[: len(FENS)]
+    sb = np.asarray(b["score"])[: len(FENS)]
+    # quantization can flip close move choices; scores must stay close
+    assert np.all(np.abs(sa - sb) <= 30), (sa, sb)
+
+
 def test_save_load_roundtrip(tmp_path, params):
     path = tmp_path / "net.npz"
     nnue.save_params(params, path)

@@ -6,9 +6,14 @@ search needs an explicit oracle instead. ops/oracle.py mirrors the device
 state machine move-for-move, so scores must agree EXACTLY — any drift is
 a search bug, not noise.
 
-All device searches here share ONE shape (B=50 lanes, max_ply=4) so the
-file pays two XLA compiles total (with/without TT) on the single-core CI
-box.
+Two tiers: the default (fast) tier proves exactness on 16 mixed positions
+at depth 1 plus the budget-truncation rule — a per-commit signal that runs
+in minutes on a single-core box. The `slow` tier widens to 50 positions
+and depths 2-3 (the host oracle recursion, not the device, is what's
+expensive: it dispatches jitted evals per visited node).
+
+All device dispatches share ONE shape (B=16 lanes, max_ply=4) so the fast
+tier pays a single XLA compile per feature set.
 """
 import random
 
@@ -23,7 +28,7 @@ from fishnet_tpu.ops.board import from_position, stack_boards
 from fishnet_tpu.ops.oracle import oracle_search
 from fishnet_tpu.ops.search import search_batch_jit
 
-B = 50
+B = 16
 MAX_PLY = 4
 
 
@@ -50,7 +55,7 @@ def _mixed_fens(n: int, seed: int = 7) -> list[str]:
     return fens
 
 
-FENS = _mixed_fens(B)
+FENS = _mixed_fens(50)
 
 
 def _device(params, fens, depth, budget, table=None):
@@ -67,6 +72,21 @@ def _device(params, fens, depth, budget, table=None):
     return {k: np.asarray(v) for k, v in out.items() if k != "tt"}
 
 
+def _device_many(params, fens, depth, budget, table=None):
+    """len(fens) > B: dispatch in B-sized slices, same compiled shape."""
+    outs = [
+        _device(params, fens[i:i + B], depth, budget, table)
+        for i in range(0, len(fens), B)
+    ]
+    n_last = len(fens) - (len(outs) - 1) * B
+    return {
+        k: np.concatenate(
+            [o[k][:B] for o in outs[:-1]] + [outs[-1][k][:n_last]]
+        )
+        for k in ("score", "nodes")
+    }
+
+
 def _assert_matches(params, out, fens, depth, budget, idxs):
     for i in idxs:
         exp = oracle_search(
@@ -78,12 +98,19 @@ def _assert_matches(params, out, fens, depth, budget, idxs):
 
 
 def test_matches_oracle_depth1(params):
-    out = _device(params, FENS, 1, 100_000)
+    out = _device(params, FENS[:B], 1, 100_000)
+    _assert_matches(params, out, FENS[:B], 1, 100_000, range(B))
+
+
+@pytest.mark.slow
+def test_matches_oracle_depth1_full(params):
+    out = _device_many(params, FENS, 1, 100_000)
     _assert_matches(params, out, FENS, 1, 100_000, range(len(FENS)))
 
 
+@pytest.mark.slow
 def test_matches_oracle_depth2(params):
-    n = 20 if nnue.is_board768(params) else 8
+    n = 16 if nnue.is_board768(params) else 8
     out = _device(params, FENS[:n], 2, 100_000)
     _assert_matches(params, out, FENS[:n], 2, 100_000, range(n))
 
@@ -103,13 +130,14 @@ def test_budget_truncation_matches_oracle(params):
     _assert_matches(params, out, FENS[:n], 3, 40, range(n))
 
 
+@pytest.mark.slow
 def test_tt_scores_bit_identical(params):
     """With exact-depth probe matching, the shared TT must not change any
     score — only node counts (reference analog: analysis output must not
     depend on what else the worker happened to search). At depth ≤3 a
     repetition needs more reversible plies than the search has, so the
     known graph-history interaction cannot bite here."""
-    plain = _device(params, FENS, 3, 1_000_000)
-    shared = _device(params, FENS, 3, 1_000_000, table=tt.make_table(18))
+    plain = _device(params, FENS[:B], 3, 1_000_000)
+    shared = _device(params, FENS[:B], 3, 1_000_000, table=tt.make_table(18))
     np.testing.assert_array_equal(plain["score"], shared["score"])
     assert int(shared["nodes"].sum()) <= int(plain["nodes"].sum())

@@ -56,6 +56,80 @@ def test_repetition_draws_match_oracle(params):
     assert total_reps > 100, f"only {total_reps} repetition hits"
 
 
+def _shuffle_game(n_plies):
+    """King shuffle from a K-vs-K start; returns (prefix list, root)."""
+    pos = Position.from_fen("k7/8/8/8/8/8/8/K7 w - - 0 1")
+    prefix = []
+    for uci in ["a1b1", "a8b8", "b1a1", "b8a8"] * 2:
+        if len(prefix) == n_plies:
+            break
+        prefix.append(pos)
+        pos = pos.push(pos.parse_uci(uci))
+    return prefix, pos
+
+
+def _oracle_history(game):
+    """Game prefix → oracle history quadruples, via the same doubled-
+    position filter the engine applies for the device."""
+    from fishnet_tpu.engine.tpu import TpuEngine
+    from fishnet_tpu.ops.search import HIST_HM_SENTINEL, MAX_HIST
+
+    hh, hm = TpuEngine._history_arrays([game], 1)
+    return (hh, hm), [
+        (int(hh[0, k, 0]), int(hh[0, k, 1]), int(hm[0, k]), MAX_HIST - k)
+        for k in range(MAX_HIST)
+        if hm[0, k] != HIST_HM_SENTINEL
+    ]
+
+
+def test_game_history_repetition_draws(params):
+    """After 8 shuffle plies every pre-root placement occurred twice, so
+    (Stockfish Position::is_draw: 'repeats twice before or at the root')
+    the root and each child read as immediate draws; device == oracle
+    exactly, and the game history is what makes it a draw."""
+    game, pos = _shuffle_game(8)
+    root = from_position(pos)
+    (hh, hm), triples = _oracle_history(game)
+    assert triples, "8-ply shuffle must yield doubled positions"
+
+    roots = stack_boards([root] * len(FENS))
+    B = len(FENS)
+    out = search_batch_jit(
+        params, roots, DEPTH, BUDGET, max_ply=MAX_PLY,
+        hist=(np.repeat(hh, B, axis=0), np.repeat(hm, B, axis=0)),
+    )
+    exp = oracle_search(params, root, DEPTH, BUDGET, MAX_PLY, history=triples)
+    assert exp["rep_hits"] > 0
+    assert int(np.asarray(out["score"])[0]) == exp["score"] == 0
+    assert int(np.asarray(out["nodes"])[0]) == exp["nodes"]
+
+    # without history the same position searches normally (no draw leaf
+    # at the root)
+    plain = search_batch_jit(params, roots, DEPTH, BUDGET, max_ply=MAX_PLY)
+    assert int(np.asarray(plain["nodes"])[0]) > int(np.asarray(out["nodes"])[0])
+
+
+def test_single_game_occurrence_is_not_a_draw(params):
+    """4 shuffle plies: the root repeats the start position once — by the
+    reference rule (distance > ply) that is NOT a draw, so the doubled-
+    position filter must plant nothing and results must equal plain
+    search."""
+    game, pos = _shuffle_game(4)
+    root = from_position(pos)
+    (hh, hm), triples = _oracle_history(game)
+    assert not triples, "singly-occurring positions must be filtered out"
+
+    roots = stack_boards([root] * len(FENS))
+    B = len(FENS)
+    out = search_batch_jit(
+        params, roots, DEPTH, BUDGET, max_ply=MAX_PLY,
+        hist=(np.repeat(hh, B, axis=0), np.repeat(hm, B, axis=0)),
+    )
+    plain = search_batch_jit(params, roots, DEPTH, BUDGET, max_ply=MAX_PLY)
+    assert int(np.asarray(out["score"])[0]) == int(np.asarray(plain["score"])[0])
+    assert int(np.asarray(out["nodes"])[0]) == int(np.asarray(plain["nodes"])[0])
+
+
 def test_repetition_not_confused_by_irreversible_moves(params):
     """A pawn move between two visually identical placements breaks the
     reversible chain — a position 'repeated' across a pawn move is NOT a

@@ -17,12 +17,21 @@ def params(request):
     )
 
 
+B = 16  # all dispatches share one padded lane shape → one compile per
+        # (max_ply, tt-presence) across the whole file (and files using
+        # the same l1=32 params in the same pytest process)
+
+
 def run(params, fens, depth, budget=100_000, max_ply=None):
-    roots = stack_boards([from_position(Position.from_fen(f)) for f in fens])
+    boards = [from_position(Position.from_fen(f)) for f in fens]
+    roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
     out = search_batch_jit(
-        params, roots, depth, budget, max_ply=(max_ply or depth + 1)
+        params, roots, depth, budget, max_ply=(max_ply or 4)
     )
-    return {k: np.asarray(v) for k, v in out.items()}
+    return {
+        k: (np.asarray(v)[: len(fens)] if np.ndim(v) else np.asarray(v))
+        for k, v in out.items() if k != "tt"
+    }
 
 
 def decode(m):
@@ -64,7 +73,7 @@ def test_depth1_matches_host_oracle(params):
     out = run(params, fens, depth=1)
     for i, fen in enumerate(fens):
         exp = oracle_search(
-            params, from_position(Position.from_fen(fen)), 1, 100_000, 2
+            params, from_position(Position.from_fen(fen)), 1, 100_000, 4
         )
         assert out["score"][i] == exp["score"], fen
         assert out["nodes"][i] == exp["nodes"], fen
@@ -88,7 +97,8 @@ def test_pv_is_legal_line(params):
 def test_mate_in_two(params):
     # classic mate in 2: 1.Qf7+?? no — use a known forced mate-in-2
     # "k7/8/2K5/8/8/8/8/7Q w": 1.Qh8? stalemate risk... use rook staircase:
-    out = run(params, ["k7/8/1K6/8/8/8/8/7R w - - 0 1"], depth=4, budget=500_000)
+    out = run(params, ["k7/8/1K6/8/8/8/8/7R w - - 0 1"], depth=4,
+              budget=500_000, max_ply=5)
     # Rh8# is immediate mate in 1 actually (a8 king, b6 K guards a7/b7/b8)
     assert out["score"][0] == MATE - 1
     assert decode(out["move"][0]) == "h1h8"
@@ -100,6 +110,7 @@ def test_node_budget_respected(params):
         ["rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"],
         depth=4,
         budget=500,
+        max_ply=5,
     )
     # budget degrades deep nodes to leaf evals; total visits stay bounded
     assert out["nodes"][0] <= 500 + 250
@@ -129,11 +140,13 @@ def test_resumable_matches_oneshot(params):
         "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
         "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
     ]
-    roots = stack_boards([from_position(Position.from_fen(f)) for f in fens])
+    boards = [from_position(Position.from_fen(f)) for f in fens]
+    roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
     one = {k: np.asarray(v) for k, v in search_batch_jit(
-        params, roots, 3, 5_000, max_ply=4).items()}
+        params, roots, 3, 5_000, max_ply=4).items() if k != "tt"}
     seg = {k: np.asarray(v) for k, v in search_batch_resumable(
-        params, roots, 3, 5_000, max_ply=4, segment_steps=97).items()}
+        params, roots, 3, 5_000, max_ply=4, segment_steps=97).items()
+        if k != "tt"}
     for k in ("score", "move", "nodes", "pv_len"):
         assert (one[k] == seg[k]).all(), k
     assert (one["pv"] == seg["pv"]).all()
@@ -150,6 +163,7 @@ def test_resumable_deadline_stops_early(params):
     roots = stack_boards(
         [from_position(Position.from_fen(
             "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1"))]
+        * B
     )
     out = search_batch_resumable(
         params, roots, 4, 500_000, max_ply=5, segment_steps=50,
