@@ -180,6 +180,21 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
     return None
 
 
+def device_preflight(timeout: float = 120.0) -> bool:
+    """Can a fresh process see the TPU at all? A wedged/down tunnel makes
+    jax init hang, which would otherwise burn one full stage timeout per
+    ramp stage before the CPU fallback ever runs."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     B = int(os.environ.get("BENCH_LANES", "256"))
     DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
@@ -191,6 +206,11 @@ def main() -> None:
     stages = [s for s in STAGES if s[0] <= B]
     if (B, DEPTH) not in stages:
         stages.append((B, DEPTH))
+
+    if not device_preflight():
+        print("bench: device preflight failed (tunnel down/wedged); "
+              "skipping device stages", file=sys.stderr, flush=True)
+        stages = []
 
     best = None  # result dict with max nps
     fails = 0

@@ -158,8 +158,15 @@ async def run(cfg: Config) -> int:
         for attempt in range(3):
             try:
                 engine = factory(EngineFlavor.TPU)
-                await asyncio.to_thread(engine.warmup)
-                logger.info("TPU engine ready.")
+                await asyncio.to_thread(engine.warmup, None, logger.info)
+                logger.info("TPU engine ready (all lane buckets compiled).")
+                # variant programs compile in the background; dispatches
+                # interleave behind the engine lock, so standard chunks
+                # flow immediately while variant chunks stop racing
+                # their deadlines within the first few minutes
+                asyncio.ensure_future(
+                    asyncio.to_thread(engine.warmup_variants, logger.info)
+                )
                 break
             except Exception as e:
                 logger.warn(f"TPU warmup attempt {attempt + 1} failed: {e}")

@@ -51,7 +51,7 @@ def _decode_uci(m: int) -> str:
         + "abcdefgh"[to & 7] + str((to >> 3) + 1)
     )
     if promo:
-        s += " nbrq"[promo]
+        s += " nbrqk"[promo]  # 5 = king (antichess promotion)
     return s
 
 
@@ -146,37 +146,108 @@ class TpuEngine:
                 params = nnue.init_params(
                     jax.random.PRNGKey(seed), l1=64, feature_set="board768"
                 )
-        # FISHNET_TPU_DTYPE=bf16 quantizes the weights to the MXU's
-        # native input type (SURVEY §7.2); accumulators stay f32
-        if os.environ.get("FISHNET_TPU_DTYPE", "").lower() in ("bf16", "bfloat16"):
+        # FISHNET_TPU_DTYPE quantizes the weights (SURVEY §7.2):
+        # bf16 → MXU-native float inputs, f32 accumulators;
+        # int8 → fixed-point ladder, int8×int8→int32 dots, exact int32
+        # accumulators (nnue.quantize_int8)
+        dtype_env = os.environ.get("FISHNET_TPU_DTYPE", "").lower()
+        if dtype_env in ("bf16", "bfloat16"):
             params = nnue.cast_params(params, jnp.bfloat16)
+        elif dtype_env == "int8":
+            if nnue.is_board768(params):
+                params = nnue.quantize_int8(params)
         self.params = params
         self.max_depth = max_depth
 
-    def warmup(self, buckets=None) -> None:
-        """Pre-compile the hot search program for the given lane buckets.
+    def warmup(self, buckets=None, log=None) -> None:
+        """Pre-compile the hot search program for every production lane
+        bucket.
 
         XLA caches one program per (lane bucket, MAX_PLY) shape; without
-        this, the first chunk pays 20-40 s of compile against its deadline
-        (move jobs have a 7 s deadline — they would always fail cold).
-        16 covers single-pv chunks; 64 covers multipv root-move lanes
-        (which pad to ≥64). The reference similarly does its engine prep
+        this, the first chunk of a new shape pays 20-40 s of compile
+        against its deadline (move jobs have a 7 s deadline — they would
+        always fail cold; a first 128/256-lane multipv chunk used to race
+        a cold compile too). The reference similarly does its engine prep
         before workers start (Assets::prepare, src/main.rs:94).
         FISHNET_TPU_WARMUP_BUCKETS="16" overrides (e.g. CPU smoke runs
-        where each extra compile costs minutes)."""
+        where each extra compile costs minutes). log: optional callable
+        for per-bucket progress lines."""
+        import time as _time
+
         if buckets is None:
             env = os.environ.get("FISHNET_TPU_WARMUP_BUCKETS")
             buckets = (
                 tuple(int(x) for x in env.split(",") if x)
                 if env
-                else LANE_BUCKETS[:2]
+                else LANE_BUCKETS
             )
         for b in buckets:
             b = self._pad(b)
+            t0 = _time.monotonic()
             roots = stack_boards([from_position(Position.initial())] * b)
             self._search(
                 roots, np.ones(b, np.int32), np.full(b, 64, np.int32)
             )
+            if log is not None:
+                log(
+                    f"warmup: {b}-lane search program compiled "
+                    f"({_time.monotonic() - t0:.1f}s)"
+                )
+
+    def warmup_variants(self, log=None) -> None:
+        """Compile the per-variant search programs (each variant is a
+        distinct statically compiled program — a cold compile at the
+        first variant chunk would race its deadline; move jobs' 7 s
+        deadline always loses that race). Meant to run in the background
+        AFTER the standard warmup: dispatches serialize behind the
+        engine lock, so live chunks interleave with these compiles.
+
+        FISHNET_TPU_WARMUP_VARIANTS: comma list, "all", or "none";
+        default warms all device variants on real accelerators and none
+        on CPU (where each extra compile costs minutes — tests and smoke
+        runs)."""
+        import time as _time
+
+        env = os.environ.get("FISHNET_TPU_WARMUP_VARIANTS", "auto")
+        if env.lower() == "auto":
+            if jax.default_backend() == "cpu":
+                return
+            variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
+        elif env.lower() in ("", "none"):
+            return
+        elif env.lower() == "all":
+            variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
+        else:
+            variants = [v for v in env.split(",") if v]
+        for variant in variants:
+            for b in (16, 64):  # single-pv chunks; move-job root lanes
+                b = self._pad(b)
+                t0 = _time.monotonic()
+                start = from_fen(
+                    {
+                        "crazyhouse": (
+                            "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR[] "
+                            "w KQkq - 0 1"
+                        ),
+                        "horde": (
+                            "rnbqkbnr/pppppppp/8/1PP2PP1/PPPPPPPP/PPPPPPPP/"
+                            "PPPPPPPP/PPPPPPPP w kq - 0 1"
+                        ),
+                        "racingKings": "8/8/8/8/8/8/krbnNBRK/qrbnNBRQ w - - 0 1",
+                    }.get(variant, "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"),
+                    variant,
+                )
+                roots = stack_boards([from_position(start)] * b)
+                with self._lock:
+                    self._search(
+                        roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
+                        variant=variant,
+                    )
+                if log is not None:
+                    log(
+                        f"warmup: {variant} {b}-lane program compiled "
+                        f"({_time.monotonic() - t0:.1f}s)"
+                    )
 
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
         loop = asyncio.get_running_loop()
@@ -541,7 +612,14 @@ class TpuEngine:
         one dispatch per iterative-deepening depth. This is where batching
         beats the reference hardest — Stockfish pays ~multipv× for
         MultiPV (reference: src/stockfish.rs:272 sets MultiPV and the
-        engine re-searches), while lanes are just lanes here."""
+        engine re-searches), while lanes are just lanes here.
+
+        Node accounting: every legal root move gets a lane, so a position
+        spends ~len(legal)× a single-PV search's NODES against the same
+        server budget (remaining//len(legal) per lane per round, so a
+        round never exceeds the remaining budget). Wall-clock is what
+        matters on TPU — the lanes run in the same lockstep dispatch —
+        and the budget check stops deepening once the pool is spent."""
         live = [i for i, p in enumerate(positions) if p.outcome() is None]
         legal: dict[int, list] = {i: positions[i].legal_moves() for i in live}
         # lane table: (position index, move index) per lane

@@ -121,7 +121,7 @@ def refresh_accumulator(params: NnueParams, board64: jnp.ndarray,
     idx = feature_indices(board64, perspective, jnp.maximum(ksq, 0))
     rows = params.ft_w[jnp.clip(idx, 0)]  # (64, L1)
     rows = jnp.where((idx >= 0)[:, None], rows, 0)
-    return params.ft_b + jnp.sum(rows, axis=0)
+    return params.ft_b + jnp.sum(rows, axis=0, dtype=acc_dtype(params))
 
 
 def accumulators(params: NnueParams, board64: jnp.ndarray) -> jnp.ndarray:
@@ -154,7 +154,7 @@ def refresh_accumulator_768(params: NnueParams, board64: jnp.ndarray,
     idx = feature_indices_768(board64, perspective)
     rows = params.ft_w[jnp.clip(idx, 0)]
     rows = jnp.where((idx >= 0)[:, None], rows, 0)
-    return params.ft_b + jnp.sum(rows, axis=0)
+    return params.ft_b + jnp.sum(rows, axis=0, dtype=acc_dtype(params))
 
 
 def accumulators_768(params: NnueParams, board64: jnp.ndarray) -> jnp.ndarray:
@@ -178,7 +178,10 @@ def apply_acc_updates_768(params: NnueParams, acc: jnp.ndarray,
         idx = feature_index_768(codes, sqs, jnp.int32(persp))  # (K,)
         rows = params.ft_w[jnp.clip(idx, 0)]  # (K, L1)
         rows = jnp.where((idx >= 0)[:, None], rows, 0)
-        delta = jnp.sum(rows * signs[:, None].astype(rows.dtype), axis=0)
+        delta = jnp.sum(
+            rows * signs[:, None].astype(rows.dtype), axis=0,
+            dtype=acc_dtype(params),
+        )
         acc = acc.at[persp].add(delta)
     return acc
 
@@ -191,6 +194,55 @@ def cast_params(params: NnueParams, dtype=jnp.bfloat16) -> NnueParams:
     Evaluations may drift a few centipawns vs f32 — use the f32 master
     weights for training and parity tests."""
     return NnueParams(*[jnp.asarray(a).astype(dtype) for a in params])
+
+
+# int8 quantization scales (Stockfish-style fixed-point ladder):
+# activations live in [0, QA] (int), weights are rounded to 1/QW steps;
+# a matmul accumulates at scale QA*QW and the >>QW_SHIFT rescales back.
+QA = 127  # activation quant — fits int8 for the MXU's int8 dot path
+QW = 64
+QW_SHIFT = 6
+
+
+def quantize_int8(params: NnueParams) -> NnueParams:
+    """f32 master weights → int fixed-point (SURVEY §7.2's int8 path).
+
+    ft_w is int16 (the accumulator sums ≤33 rows, far within int32);
+    hidden/output weights are int8, biases pre-scaled int32. Incremental
+    accumulator updates become EXACT integer adds (no f32 drift down the
+    search stack), and the hidden matmuls run int8×int8→int32 — the
+    MXU's highest-throughput mode. Same NnueParams container: the
+    integer dtype is the dispatch flag (is_int8)."""
+    f = lambda a: np.asarray(a, np.float64)  # noqa: E731
+    return NnueParams(
+        ft_w=jnp.asarray(np.round(f(params.ft_w) * QA), jnp.int16),
+        ft_b=jnp.asarray(np.round(f(params.ft_b) * QA), jnp.int32),
+        l1_w=jnp.asarray(
+            np.clip(np.round(f(params.l1_w) * QW), -127, 127), jnp.int8
+        ),
+        l1_b=jnp.asarray(np.round(f(params.l1_b) * QA * QW), jnp.int32),
+        l2_w=jnp.asarray(
+            np.clip(np.round(f(params.l2_w) * QW), -127, 127), jnp.int8
+        ),
+        l2_b=jnp.asarray(np.round(f(params.l2_b) * QA * QW), jnp.int32),
+        out_w=jnp.asarray(
+            np.clip(np.round(f(params.out_w) * QW), -127, 127), jnp.int8
+        ),
+        out_b=jnp.asarray(np.round(f(params.out_b) * QA * QW), jnp.int32),
+    )
+
+
+def is_int8(params) -> bool:
+    return (
+        isinstance(params, NnueParams)
+        and jnp.issubdtype(jnp.asarray(params.ft_w).dtype, jnp.integer)
+    )
+
+
+def acc_dtype(params) -> jnp.dtype:
+    """Search accumulator dtype for a params set (int32 under int8
+    quantization — integer adds are exact; f32 otherwise)."""
+    return jnp.int32 if is_int8(params) else jnp.float32
 
 
 def is_board768(params) -> bool:
@@ -217,6 +269,23 @@ def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
     """Centipawn score from the side to move's perspective (scalar f32)."""
     own = jnp.where(stm == 0, acc[0], acc[1])
     opp = jnp.where(stm == 0, acc[1], acc[0])
+    if is_int8(params):
+        # fixed-point ladder: activations [0,QA] int8, weights 1/QW
+        # steps, int8×int8→int32 dots (the MXU's fastest mode), >>6
+        # rescale between layers; exact integer arithmetic throughout
+        x = jnp.clip(jnp.concatenate([own, opp]), 0, QA).astype(jnp.int8)
+        h = jnp.matmul(
+            x, params.l1_w[bucket], preferred_element_type=jnp.int32
+        ) + params.l1_b[bucket]
+        h = jnp.clip(h >> QW_SHIFT, 0, QA).astype(jnp.int8)
+        h = jnp.matmul(
+            h, params.l2_w[bucket], preferred_element_type=jnp.int32
+        ) + params.l2_b[bucket]
+        h = jnp.clip(h >> QW_SHIFT, 0, QA).astype(jnp.int8)
+        out = jnp.matmul(
+            h, params.out_w[bucket], preferred_element_type=jnp.int32
+        ) + params.out_b[bucket]
+        return out.astype(jnp.float32) * (OUTPUT_SCALE / (QA * QW))
     x = jnp.concatenate([_crelu(own), _crelu(opp)])  # (2*L1,)
     w1 = params.l1_w[bucket]
     h = _crelu(x @ w1 + params.l1_b[bucket])

@@ -139,9 +139,11 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
     else:
         root_acc = jnp.zeros((B, 2, l1), params.ft_w.dtype)
     # acc stays f32 even under bf16-quantized weights (nnue.cast_params):
-    # incremental adds accumulate rounding error down the stack otherwise
-    acc = jnp.zeros((B, P + 1, 2, l1), jnp.float32)
-    acc = acc.at[:, 0].set(root_acc.astype(jnp.float32))
+    # incremental adds accumulate rounding error down the stack otherwise.
+    # int8-quantized nets use int32 accumulators — integer adds are exact.
+    adt = nnue.acc_dtype(params)
+    acc = jnp.zeros((B, P + 1, 2, l1), adt)
+    acc = acc.at[:, 0].set(root_acc.astype(adt))
 
     def z(*shape, dtype=jnp.int32, fill=0):
         return jnp.full((B, *shape), fill, dtype=dtype)

@@ -27,7 +27,10 @@ from fishnet_tpu.ops.board import make_move
 from fishnet_tpu.ops.oracle import oracle_search
 from fishnet_tpu.ops.search import search_batch_jit
 
-_PROMO_MAP = {1: T.PROMO_N, 2: T.PROMO_B, 3: T.PROMO_R, 4: T.PROMO_Q}
+_PROMO_MAP = {
+    1: T.PROMO_N, 2: T.PROMO_B, 3: T.PROMO_R, 4: T.PROMO_Q,
+    5: T.PROMO_K,  # antichess promotes to king (host piece type 5)
+}
 
 
 def encode_host_move(m: Move) -> int:
@@ -255,6 +258,15 @@ def test_antichess_running_out_of_pieces_wins(params):
         params, "8/8/8/8/2q5/3q4/2P5/8 w - - 0 1", "antichess", depth=3
     )
     assert score >= MATE - 10, score
+
+
+def test_decode_uci_handles_king_promotion():
+    from fishnet_tpu.engine.tpu import _decode_uci
+    from fishnet_tpu.ops import tables as T
+
+    # e7e8k (antichess): promo code 5 must decode, not IndexError
+    m = 52 | (60 << 6) | (T.PROMO_K << 12)
+    assert _decode_uci(m) == "e7e8k"
 
 
 def test_variant_chunk_through_engine(variant):

@@ -68,6 +68,73 @@ def test_bf16_search_runs_and_stays_close(params):
     assert np.all(np.abs(sa - sb) <= 30), (sa, sb)
 
 
+def test_int8_quantization_tolerance(params):
+    """int8 fixed-point evals must stay within quantization error of the
+    f32 master (QW=64 weight steps dominate; tolerance sized to that)."""
+    if not nnue.is_board768(params):
+        pytest.skip("int8 path is board768-only")
+    q = nnue.quantize_int8(params)
+    assert nnue.is_int8(q) and q.l1_w.dtype == jnp.int8
+    ev = jax.jit(nnue.evaluate)
+    for fen in FENS:
+        b = from_position(Position.from_fen(fen))
+        f32 = float(ev(params, b.board, b.stm))
+        i8 = float(ev(q, b.board, b.stm))
+        assert abs(f32 - i8) <= 25.0, (fen, f32, i8)
+
+
+def test_int8_incremental_is_exact(params):
+    """Integer accumulators make incremental updates EXACTLY equal to a
+    refresh — no tolerance (the whole point of the int path)."""
+    if not nnue.is_board768(params):
+        pytest.skip("int8 path is board768-only")
+    import random
+
+    q = nnue.quantize_int8(params)
+    upd = jax.jit(
+        lambda b, acc, mv: nnue.apply_acc_updates_768(
+            q, acc, *move_piece_changes(b, mv)
+        )
+    )
+    refresh = jax.jit(lambda board: nnue.accumulators_768(q, board))
+    mk = jax.jit(make_move)
+    rng = random.Random(5)
+    pos = Position.from_fen(FENS[1])
+    b = from_position(pos)
+    acc = refresh(b.board)
+    for _ in range(12):
+        moves = pos.legal_moves()
+        if not moves:
+            break
+        mv = rng.choice(moves)
+        enc = mv.from_sq | (mv.to_sq << 6)
+        if mv.promotion is not None:
+            enc |= {1: 1, 2: 2, 3: 3, 4: 4}[mv.promotion] << 12
+        acc = upd(b, acc, enc)
+        pos = pos.push(mv)
+        b = from_position(pos)
+        np.testing.assert_array_equal(
+            np.asarray(acc), np.asarray(refresh(b.board))
+        )
+
+
+def test_int8_search_runs(params):
+    """A depth-2 search under int8 weights completes with sane scores."""
+    if not nnue.is_board768(params):
+        pytest.skip("int8 path is board768-only")
+    from fishnet_tpu.ops.board import stack_boards
+    from fishnet_tpu.ops.search import search_batch_jit
+
+    boards = [from_position(Position.from_fen(f)) for f in FENS]
+    roots = stack_boards(boards * 4)  # 16 lanes, the shared test shape
+    q = nnue.quantize_int8(params)
+    a = search_batch_jit(params, roots, 2, 50_000, max_ply=4)
+    b = search_batch_jit(q, roots, 2, 50_000, max_ply=4)
+    sa = np.asarray(a["score"])[: len(FENS)]
+    sb = np.asarray(b["score"])[: len(FENS)]
+    assert np.all(np.abs(sa - sb) <= 60), (sa, sb)
+
+
 def test_save_load_roundtrip(tmp_path, params):
     path = tmp_path / "net.npz"
     nnue.save_params(params, path)

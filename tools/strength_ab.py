@@ -5,6 +5,11 @@ head-to-head. This harness plays N games of (device search @ depth D)
 against PyEngine (material+mobility, depth d) from varied short random
 openings, alternating colors, and prints W/D/L + score.
 
+All games play SIMULTANEOUSLY: each move cycle batches every live game
+where it is the net's turn into one lockstep search dispatch (the same
+lanes-are-cheap property the engine exploits), so N games cost ~one
+game's worth of dispatches instead of N.
+
 Usage:
   python tools/strength_ab.py --net fishnet_tpu/assets/nnue-board768-64.npz \
       --games 200 --depth 3
@@ -59,63 +64,88 @@ def main() -> int:
         )
         return line[0] if line else None
 
-    def device_move(pos):
-        roots = stack_boards([from_position(pos)])
+    from fishnet_tpu.engine.tpu import _decode_uci as decode_uci
+
+    PAD = 16  # lane bucket granularity: few distinct compiled shapes
+
+    def device_moves(positions):
+        """One batched dispatch: best move per position (None on fail)."""
+        if not positions:
+            return []
+        boards = [from_position(p) for p in positions]
+        B = ((len(boards) + PAD - 1) // PAD) * PAD
+        roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
         out = search_batch_jit(
             params, roots, args.depth, 500_000, max_ply=args.depth + 3
         )
-        m = int(np.asarray(out["move"])[0])
-        if m < 0:
-            return None
-        frm, to, promo = m & 63, (m >> 6) & 63, (m >> 12) & 7
-        uci = (
-            "abcdefgh"[frm & 7] + str((frm >> 3) + 1)
-            + "abcdefgh"[to & 7] + str((to >> 3) + 1)
-        )
-        if promo:
-            uci += " nbrq"[promo]
-        return uci
+        ms = np.asarray(out["move"])[: len(boards)]
+        return [decode_uci(int(m)) if int(m) >= 0 else None for m in ms]
 
-    w = d = l = 0
-    for game in range(args.games):
+    # set up all games, then advance them in lockstep cycles
+    games = []
+    for g in range(args.games):
         pos = Position.initial()
         for _ in range(rng.randrange(2, 6)):  # varied opening
             moves = pos.legal_moves()
             if not moves:
                 break
             pos = pos.push(rng.choice(moves))
-        net_color = game % 2
-        plies = 0
-        outcome = None
-        while plies < args.max_plies:
-            oc = pos.outcome()
-            if oc is not None:
-                outcome = oc[0]
-                break
-            if not pos.legal_moves():
-                outcome = None
-                break
-            if pos.turn == net_color:
-                uci = device_move(pos)
-                if uci is None:
-                    break
-                pos = pos.push_uci(uci)
-            else:
-                uci = py_move(pos)
-                if uci is None:
-                    break
-                pos = pos.push_uci(uci)
-            plies += 1
+        games.append({"pos": pos, "net_color": g % 2, "plies": 0,
+                      "result": None, "live": True})
+
+    w = d = l = 0
+
+    def settle(g, outcome):
+        nonlocal w, d, l
+        g["live"] = False
+        g["result"] = outcome
         if outcome is None:
             d += 1
-        elif outcome == net_color:
+        elif outcome == g["net_color"]:
             w += 1
         else:
             l += 1
-        if (game + 1) % 10 == 0:
+
+    cycle = 0
+    while any(g["live"] for g in games):
+        cycle += 1
+        # terminal checks + PyEngine replies (cheap, host-side)
+        for g in games:
+            if not g["live"]:
+                continue
+            pos = g["pos"]
+            oc = pos.outcome()
+            if oc is not None:
+                settle(g, oc[0])
+                continue
+            if g["plies"] >= args.max_plies or not pos.legal_moves():
+                settle(g, None)
+                continue
+            if pos.turn != g["net_color"]:
+                uci = py_move(pos)
+                if uci is None:
+                    settle(g, None)
+                    continue
+                g["pos"] = pos.push_uci(uci)
+                g["plies"] += 1
+        # net replies: every live game at the net's turn, one dispatch
+        net_turn = [
+            g for g in games
+            if g["live"] and g["pos"].outcome() is None
+            and g["pos"].legal_moves() and g["pos"].turn == g["net_color"]
+        ]
+        ucis = device_moves([g["pos"] for g in net_turn])
+        for g, uci in zip(net_turn, ucis):
+            if uci is None:
+                settle(g, None)
+                continue
+            g["pos"] = g["pos"].push_uci(uci)
+            g["plies"] += 1
+        if cycle % 20 == 0:
+            done = sum(1 for g in games if not g["live"])
             print(
-                f"[{args.label}] {game + 1}/{args.games}: +{w} ={d} -{l} "
-                f"score {(w + 0.5 * d) / (game + 1):.3f}",
+                f"[{args.label}] cycle {cycle}: {done}/{args.games} games "
+                f"done, +{w} ={d} -{l}",
                 flush=True,
             )
     print(
