@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import sys
 import threading
 import time
 from typing import List, Optional
@@ -158,6 +159,15 @@ class TpuEngine:
                 params = nnue.quantize_int8(params)
         self.params = params
         self.max_depth = max_depth
+        # FISHNET_TPU_TRACE=1: per-dispatch / per-depth timing lines to
+        # stderr (verdict A1: a hang or slow depth must be localizable
+        # from logs — compile-vs-run shows up as a slow FIRST dispatch
+        # of a shape, steady-state cost as the later ones)
+        self.trace = (
+            (lambda msg: print(f"T: {msg}", file=sys.stderr, flush=True))
+            if os.environ.get("FISHNET_TPU_TRACE")
+            else None
+        )
 
     def warmup(self, buckets=None, log=None) -> None:
         """Pre-compile the hot search program for every production lane
@@ -174,6 +184,7 @@ class TpuEngine:
         for per-bucket progress lines."""
         import time as _time
 
+        env_trimmed = False  # env trimmed the set (CPU smoke runs/tests)
         if buckets is None:
             env = os.environ.get("FISHNET_TPU_WARMUP_BUCKETS")
             buckets = (
@@ -181,6 +192,7 @@ class TpuEngine:
                 if env
                 else LANE_BUCKETS
             )
+            env_trimmed = env is not None
         for b in buckets:
             b = self._pad(b)
             t0 = _time.monotonic()
@@ -193,6 +205,25 @@ class TpuEngine:
                     f"warmup: {b}-lane search program compiled "
                     f"({_time.monotonic() - t0:.1f}s)"
                 )
+        # move jobs run a DISTINCT program (deep-bounds TT probes are a
+        # static compile flag) at the 64-lane root-move bucket — without
+        # this the first move job pays a cold compile against its 7 s
+        # deadline and always fails. Skipped only when the env trimmed
+        # the set (CPU smoke runs; explicit callers get full prep).
+        if env_trimmed:
+            return
+        b = self._pad(64)  # root-move lanes pad to 64 for ≤64 legal moves
+        t0 = _time.monotonic()
+        roots = stack_boards([from_position(Position.initial())] * b)
+        self._search(
+            roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
+            deep_tt=True,
+        )
+        if log is not None:
+            log(
+                f"warmup: {b}-lane move-job program compiled "
+                f"({_time.monotonic() - t0:.1f}s)"
+            )
 
     def warmup_variants(self, log=None) -> None:
         """Compile the per-variant search programs (each variant is a
@@ -220,7 +251,11 @@ class TpuEngine:
         else:
             variants = [v for v in env.split(",") if v]
         for variant in variants:
-            for b in (16, 64):  # single-pv chunks; move-job root lanes
+            # 16 lanes / exact-depth probes: analysis chunks.
+            # 64 lanes / deep-bounds probes: move-job root-move lanes
+            # (the reference routes ALL move jobs to the variant engine,
+            # src/queue.rs:562-568, so this is the deadline-critical one)
+            for b, deep in ((16, False), (64, True)):
                 b = self._pad(b)
                 t0 = _time.monotonic()
                 start = from_fen(
@@ -241,7 +276,7 @@ class TpuEngine:
                 with self._lock:
                     self._search(
                         roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
-                        variant=variant,
+                        variant=variant, deep_tt=deep,
                     )
                 if log is not None:
                     log(
@@ -270,17 +305,29 @@ class TpuEngine:
         return b
 
     def _search(self, roots, depth_arr, budget_arr, deadline=None,
-                variant="standard", hist=None, window=None):
+                variant="standard", hist=None, window=None,
+                deep_tt=False):
         # the TT is shared across variants: variant state is hashed into
         # the key (ops/tt.py), so entries can't collide across rule sets
+        t0 = time.monotonic()
         out = search_batch_resumable(
             self.params, roots, jnp.asarray(depth_arr),
             jnp.asarray(budget_arr), max_ply=MAX_PLY,
             deadline=deadline, tt=self.tt, mesh=self.mesh,
-            variant=variant, hist=hist, window=window,
+            variant=variant, hist=hist, window=window, deep_tt=deep_tt,
         )
         self.tt = out.pop("tt")
-        return {k: np.asarray(v) for k, v in out.items()}
+        out = {k: np.asarray(v) for k, v in out.items()}
+        if self.trace:
+            dt = time.monotonic() - t0
+            nodes = int(out["nodes"].sum())
+            self.trace(
+                f"dispatch variant={variant} B={int(roots.stm.shape[0])} "
+                f"maxdepth={int(np.max(depth_arr))} steps={int(out['steps'])} "
+                f"nodes={nodes} wall={dt:.3f}s "
+                f"nps={nodes / max(dt, 1e-9):,.0f}"
+            )
+        return out
 
     def _search_windowed(self, roots, depth_arr, budget_arr, deadline,
                          variant, hist, prev_score, use_win):
@@ -463,6 +510,10 @@ class TpuEngine:
                     roots, depth_arr, np.full(B, 10_000_000, np.int32),
                     hard_deadline if depth == 1 else soft_deadline,
                     variant=variant, hist=hist,
+                    # move jobs report a MOVE, not a score: deeper TT
+                    # bounds cut more (reference depth>= rule) and the
+                    # score-determinism concern doesn't apply
+                    deep_tt=True,
                 )
                 if not bool(out["done"][: len(legal)].all()):
                     break  # movetime/deadline hit: keep the previous depth
@@ -551,10 +602,17 @@ class TpuEngine:
                     have_prev & (np.abs(prev_score) < MATE - 1000)
                     & (depth >= 2)
                 )
+                t_depth = time.monotonic()
                 out = self._search_windowed(
                     roots, depth_arr, budget_arr, deadline,
                     variant, hist, prev_score, use_win,
                 )
+                if self.trace:
+                    self.trace(
+                        f"ID depth={depth} B={B} lanes={len(lanes)} "
+                        f"nodes={int(out['nodes'].sum())} "
+                        f"wall={time.monotonic() - t_depth:.3f}s"
+                    )
                 exhausted_all = True
                 for j, i in enumerate(lanes):
                     if remaining[j] <= 0 or not bool(out["done"][j]):

@@ -577,8 +577,11 @@ def _gather_ply(arr: jnp.ndarray, ply: jnp.ndarray) -> jnp.ndarray:
 
 
 def _run_segment(params: nnue.NnueParams, state: SearchState,
-                 ttab, segment_steps: int, variant: str = "standard"):
+                 ttab, segment_steps: int, variant: str = "standard",
+                 deep_tt: bool = False):
     """Advance all lanes ≤ segment_steps. ttab: shared tt.TTable or None.
+    deep_tt (STATIC): accept deeper LOWER/UPPER TT entries as cutoffs
+    (move-job strength mode — see ops/tt.py probe).
 
     The TT lives OUTSIDE the vmap: each iteration first stores every lane
     parked in RETURN (its finished node's value), then probes every lane
@@ -647,7 +650,8 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
                 s.ply == 0, s.root_beta, -_gather_ply(s.alpha, parent)
             )
             usable, score, _mv, order_mv = _tt_mod.probe(
-                t, h1, h2, s.depth_limit - s.ply, a_w, b_w
+                t, h1, h2, s.depth_limit - s.ply, a_w, b_w,
+                deep_bounds=deep_tt,
             )
             usable &= enter
             order_mv = jnp.where(enter, order_mv, -1)
@@ -674,7 +678,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
 
 
 _run_segment_jit = jax.jit(
-    _run_segment, static_argnames=("segment_steps", "variant")
+    _run_segment, static_argnames=("segment_steps", "variant", "deep_tt")
 )
 _init_state_jit = jax.jit(init_state, static_argnames=("max_ply", "variant"))
 
@@ -705,12 +709,16 @@ def search_batch_resumable(
     variant: str = "standard",
     hist=None,
     window=None,
+    deep_tt: bool = False,
 ):
     """Like `search_batch`, but dispatched in bounded segments.
 
     window: optional (root_alpha (B,), root_beta (B,)) aspiration window;
     a root whose true value falls outside reports a bound (fail-low /
     fail-high) — the caller re-searches with a wider window.
+
+    deep_tt: accept deeper LOWER/UPPER TT entries as cutoffs (move-job
+    strength mode; analysis keeps deterministic exact-depth probes).
 
     deadline: absolute time.monotonic() stamp; between segments the host
     stops early when passed. Lanes not DONE at stop report done=False and
@@ -742,7 +750,8 @@ def search_batch_resumable(
 
         def dispatch(state, tt):
             state, tt, n = run_segment_sharded(
-                mesh, params, state, tt, segment_steps, variant=variant
+                mesh, params, state, tt, segment_steps, variant=variant,
+                deep_tt=deep_tt,
             )
             # devices stop independently; continue while ANY used the
             # full segment (i.e. may still have live lanes)
@@ -750,7 +759,7 @@ def search_batch_resumable(
     else:
         def dispatch(state, tt):
             state, tt, n = _run_segment_jit(
-                params, state, tt, segment_steps, variant
+                params, state, tt, segment_steps, variant, deep_tt
             )
             return state, tt, int(n)
 

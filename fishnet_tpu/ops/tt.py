@@ -143,13 +143,20 @@ def unpack_meta(meta):
     return score, depth, flag
 
 
-def probe(tt: TTable, h1, h2, depth_left, alpha, beta):
+def probe(tt: TTable, h1, h2, depth_left, alpha, beta,
+          deep_bounds: bool = False):
     """Batched probe: → (usable, score, move, ordering_move).
 
     usable: entry valid AND deep enough AND its bound cuts the (alpha,
     beta) window. ordering_move: the stored move whenever the entry is
     merely valid (usable for move ordering even when depth is too
-    shallow)."""
+    shallow).
+
+    deep_bounds (STATIC): additionally accept DEEPER LOWER/UPPER entries
+    as cutoffs (the reference engine's depth >= rule). Sound for finding
+    the best MOVE, but the cutoff value then depends on what else was
+    searched — move jobs opt in for strength; analysis keeps the exact
+    rule below for deterministic scores."""
     slot = (h1 & jnp.uint32(tt.size - 1)).astype(jnp.int32)
     meta = tt.meta[slot]
     move = tt.move[slot]
@@ -165,7 +172,12 @@ def probe(tt: TTable, h1, h2, depth_left, alpha, beta):
     # root score is bit-identical with or without the table (determinism is
     # a feature for analysis: same job → same output regardless of batch
     # composition). Deeper entries still help via the ordering move.
-    deep_enough = depth == jnp.maximum(depth_left, 0)
+    if deep_bounds:
+        # the reference rule: any at-least-as-deep entry cuts (EXACT
+        # included — a deeper exact value is the strongest hit of all)
+        deep_enough = depth >= jnp.maximum(depth_left, 0)
+    else:
+        deep_enough = depth == jnp.maximum(depth_left, 0)
     cuts = jnp.where(
         flag == FLAG_EXACT,
         True,

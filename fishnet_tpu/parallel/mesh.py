@@ -48,7 +48,7 @@ def replicate(mesh: Mesh, tree):
 
 @functools.lru_cache(maxsize=None)
 def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
-                      variant: str = "standard"):
+                      variant: str = "standard", deep_tt: bool = False):
     """shard_map'd search segment: each device advances ITS lanes with ITS
     transposition-table shard, fully locally — no collectives, and a device
     whose lanes all park in DONE exits its while_loop early instead of
@@ -61,7 +61,7 @@ def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[0], ttab)  # (1, N) block → (N,)
         state, ttab, n = _run_segment(
-            params, state, ttab, segment_steps, variant
+            params, state, ttab, segment_steps, variant, deep_tt
         )
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[None], ttab)
@@ -78,13 +78,16 @@ def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
 
 
 def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
-                        axis: str = "dp", variant: str = "standard"):
+                        axis: str = "dp", variant: str = "standard",
+                        deep_tt: bool = False):
     """Advance a sharded search ≤ segment_steps on every device.
 
     state: SearchState with lane dim divisible by mesh size. ttab: TTable
     whose arrays carry a leading (n_devices,) shard dim (see
     make_sharded_table), or None. Returns (state, ttab, steps (ndev,))."""
-    fn = _segment_callable(mesh, axis, segment_steps, ttab is not None, variant)
+    fn = _segment_callable(
+        mesh, axis, segment_steps, ttab is not None, variant, deep_tt
+    )
     return fn(params, state, ttab)
 
 
