@@ -254,8 +254,11 @@ class TpuEngine:
             # 16 lanes / exact-depth probes: analysis chunks.
             # 64 lanes / deep-bounds probes: move-job root-move lanes
             # (the reference routes ALL move jobs to the variant engine,
-            # src/queue.rs:562-568, so this is the deadline-critical one)
-            for b, deep in ((16, False), (64, True)):
+            # src/queue.rs:562-568, so this is the deadline-critical
+            # one). Crazyhouse drops push legal counts past 64, so its
+            # move jobs bucket to 128.
+            move_bucket = 128 if variant == "crazyhouse" else 64
+            for b, deep in ((16, False), (move_bucket, True)):
                 b = self._pad(b)
                 t0 = _time.monotonic()
                 start = from_fen(
@@ -492,7 +495,11 @@ class TpuEngine:
                 responses.append(self._terminal_response(chunk, wp, pos, 0.001))
                 continue
             legal = pos.legal_moves()
-            B = self._pad(max(len(legal), 1))
+            # pad to >=64 so every move job shares the warmed 64-lane
+            # deep-probe program (a <=16-legal endgame would otherwise
+            # bucket to a 16-lane program nothing compiles ahead of its
+            # 7 s deadline); lanes are cheap, cold compiles are not
+            B = self._pad(max(len(legal), 64))
             boards = [from_position(pos.push(m)) for m in legal]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             # every root-move lane shares the same history: the game

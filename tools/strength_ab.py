@@ -29,6 +29,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--net", required=True)
+    ap.add_argument("--opponent-net", default=None,
+                    help="net-vs-net: the opponent plays device search "
+                         "with THIS net (at --py-depth) instead of "
+                         "PyEngine")
     ap.add_argument("--games", type=int, default=200)
     ap.add_argument("--depth", type=int, default=3)
     ap.add_argument("--py-depth", type=int, default=2)
@@ -68,18 +72,24 @@ def main() -> int:
 
     PAD = 16  # lane bucket granularity: few distinct compiled shapes
 
-    def device_moves(positions):
+    def device_moves(positions, p=None, depth=None):
         """One batched dispatch: best move per position (None on fail)."""
         if not positions:
             return []
-        boards = [from_position(p) for p in positions]
+        p = params if p is None else p
+        depth = args.depth if depth is None else depth
+        boards = [from_position(pos) for pos in positions]
         B = ((len(boards) + PAD - 1) // PAD) * PAD
         roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
         out = search_batch_jit(
-            params, roots, args.depth, 500_000, max_ply=args.depth + 3
+            p, roots, depth, 500_000, max_ply=depth + 3
         )
         ms = np.asarray(out["move"])[: len(boards)]
         return [decode_uci(int(m)) if int(m) >= 0 else None for m in ms]
+
+    opp_params = (
+        nnue.load_params(args.opponent_net) if args.opponent_net else None
+    )
 
     # set up all games, then advance them in lockstep cycles
     games = []
@@ -109,7 +119,8 @@ def main() -> int:
     cycle = 0
     while any(g["live"] for g in games):
         cycle += 1
-        # terminal checks + PyEngine replies (cheap, host-side)
+        # terminal checks
+        opp_turn = []
         for g in games:
             if not g["live"]:
                 continue
@@ -122,12 +133,26 @@ def main() -> int:
                 settle(g, None)
                 continue
             if pos.turn != g["net_color"]:
-                uci = py_move(pos)
+                if opp_params is not None:
+                    opp_turn.append(g)
+                    continue
+                uci = py_move(pos)  # host-side PyEngine reply
                 if uci is None:
                     settle(g, None)
                     continue
                 g["pos"] = pos.push_uci(uci)
                 g["plies"] += 1
+        # opponent-net replies (net-vs-net mode): one batched dispatch
+        for g, uci in zip(
+            opp_turn,
+            device_moves([g["pos"] for g in opp_turn],
+                         p=opp_params, depth=args.py_depth),
+        ):
+            if uci is None:
+                settle(g, None)
+                continue
+            g["pos"] = g["pos"].push_uci(uci)
+            g["plies"] += 1
         # net replies: every live game at the net's turn, one dispatch
         net_turn = [
             g for g in games

@@ -23,7 +23,6 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main() -> int:
@@ -43,15 +42,8 @@ def main() -> int:
     ap.add_argument("--out", default="/tmp/net-search-distilled.npz")
     args = ap.parse_args()
 
+    from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
     import jax
-
-    try:
-        import jax._src.xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
     import jax.numpy as jnp
     import numpy as np
     import optax
