@@ -241,11 +241,17 @@ def main() -> None:
         # first and keep the tiny shape as the last resort.
         print("device bench failed entirely; falling back to CPU",
               file=sys.stderr, flush=True)
-        for b, d in ((64, 3), (8, 2)):
+        fallbacks = ((64, 3), (8, 2))
+        for i, (b, d) in enumerate(fallbacks):
             remaining = total_budget - (time.time() - t_start)
-            best = run_stage(b, d, BUDGET,
-                             max(60.0, min(stage_timeout * 2, remaining)),
-                             force_cpu=True)
+            # keep a reserve so the last-resort tiny stage always gets a
+            # real slice of budget even if the wide stage times out
+            reserve = 180.0 * (len(fallbacks) - 1 - i)
+            best = run_stage(
+                b, d, BUDGET,
+                max(60.0, min(stage_timeout * 2, remaining - reserve)),
+                force_cpu=True,
+            )
             if best is not None:
                 break
         label = " [CPU FALLBACK — device unusable]"

@@ -488,6 +488,7 @@ class TpuEngine:
         soft_deadline = min(
             hard_deadline, started + level.movetime_ms / 1000.0
         )
+        variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
 
         responses = []
         for wp, pos, game in zip(chunk.positions, positions, games):
@@ -495,16 +496,18 @@ class TpuEngine:
                 responses.append(self._terminal_response(chunk, wp, pos, 0.001))
                 continue
             legal = pos.legal_moves()
-            # pad to >=64 so every move job shares the warmed 64-lane
-            # deep-probe program (a <=16-legal endgame would otherwise
-            # bucket to a 16-lane program nothing compiles ahead of its
-            # 7 s deadline); lanes are cheap, cold compiles are not
-            B = self._pad(max(len(legal), 64))
+            # pad to the variant's warmed move-job bucket so every job
+            # shares ONE pre-compiled deep-probe program (a <=16-legal
+            # endgame would otherwise bucket to a 16-lane program nothing
+            # compiles ahead of its 7 s deadline; crazyhouse warms 128
+            # because drops push legal counts past 64) — lanes are
+            # cheap, cold compiles are not
+            floor = 128 if variant == "crazyhouse" else 64
+            B = self._pad(max(len(legal), floor))
             boards = [from_position(pos.push(m)) for m in legal]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             # every root-move lane shares the same history: the game
             # prefix plus the position the move was played from
-            variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
             hist = self._history_arrays([game + [pos]] * B, B, variant)
 
             ranked = []

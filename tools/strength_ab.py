@@ -41,15 +41,7 @@ def main() -> int:
     ap.add_argument("--label", default="net")
     args = ap.parse_args()
 
-    import jax
-
-    try:
-        import jax._src.xla_bridge as _xb
-
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
     import numpy as np
 
     from fishnet_tpu.chess import Position

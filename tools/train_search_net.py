@@ -101,7 +101,11 @@ def main() -> int:
         params = nnue.init_params(
             jax.random.PRNGKey(args.seed), l1=base.l1, feature_set="board768"
         )
-    optimizer = optax.adam(args.lr)
+    # cosine decay: the first self-distillation attempt diverged late on
+    # a flat lr (docs/strength.md) — search-backup labels are noisy
+    optimizer = optax.adam(
+        optax.cosine_decay_schedule(args.lr, args.steps)
+    )
     opt_state = optimizer.init(params)
     step = make_train_step(optimizer)
     rng = np.random.default_rng(args.seed)
