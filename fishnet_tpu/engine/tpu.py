@@ -83,6 +83,14 @@ def _score_from_int(v: int, root_ply_to_mate_sign: int = 1) -> Score:
     return Score.cp(int(v))
 
 
+def _move_job_floor(variant: str) -> int:
+    """Minimum move-job lane count per variant — MUST match what
+    warmup_variants precompiles, or the first job pays a cold compile
+    against its 7 s deadline. Crazyhouse drops push legal counts past
+    64, so its bucket is 128."""
+    return 128 if variant == "crazyhouse" else 64
+
+
 def _pad_lanes(n: int) -> int:
     for b in LANE_BUCKETS:
         if n <= b:
@@ -252,13 +260,11 @@ class TpuEngine:
             variants = [v for v in env.split(",") if v]
         for variant in variants:
             # 16 lanes / exact-depth probes: analysis chunks.
-            # 64 lanes / deep-bounds probes: move-job root-move lanes
-            # (the reference routes ALL move jobs to the variant engine,
-            # src/queue.rs:562-568, so this is the deadline-critical
-            # one). Crazyhouse drops push legal counts past 64, so its
-            # move jobs bucket to 128.
-            move_bucket = 128 if variant == "crazyhouse" else 64
-            for b, deep in ((16, False), (move_bucket, True)):
+            # _move_job_floor lanes / deep-bounds probes: move-job
+            # root-move lanes (the reference routes ALL move jobs to the
+            # variant engine, src/queue.rs:562-568, so this is the
+            # deadline-critical one)
+            for b, deep in ((16, False), (_move_job_floor(variant), True)):
                 b = self._pad(b)
                 t0 = _time.monotonic()
                 start = from_fen(
@@ -499,11 +505,9 @@ class TpuEngine:
             # pad to the variant's warmed move-job bucket so every job
             # shares ONE pre-compiled deep-probe program (a <=16-legal
             # endgame would otherwise bucket to a 16-lane program nothing
-            # compiles ahead of its 7 s deadline; crazyhouse warms 128
-            # because drops push legal counts past 64) — lanes are
-            # cheap, cold compiles are not
-            floor = 128 if variant == "crazyhouse" else 64
-            B = self._pad(max(len(legal), floor))
+            # compiles ahead of its 7 s deadline) — lanes are cheap,
+            # cold compiles are not
+            B = self._pad(max(len(legal), _move_job_floor(variant)))
             boards = [from_position(pos.push(m)) for m in legal]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             # every root-move lane shares the same history: the game

@@ -23,6 +23,7 @@ lanes are cheap, so multipv lanes are just more lanes.
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -60,6 +61,25 @@ MODE_DONE = 3
 # halfmove, which can never satisfy the reversible-chain condition.
 MAX_HIST = 16
 HIST_HM_SENTINEL = -32000
+
+# FISHNET_TPU_SELECT_UPDATES=1: implement every per-lane dynamic row
+# write as a one-hot masked select instead of a dynamic-update-slice
+# scatter. This is the candidate workaround for the device fault
+# bisected in docs/tpu-hang.md (B>=16 lanes with max_ply>=4 hangs or
+# kills the TPU worker — suspected miscompiled scatter at multi-sublane
+# lane counts), and masked selects are often faster on TPU anyway. The
+# two modes are bit-identical (tests/test_search.py proves it on CPU).
+_SELECT_UPDATES = bool(os.environ.get("FISHNET_TPU_SELECT_UPDATES"))
+
+
+def _row_set(arr: jnp.ndarray, idx, row, mask) -> jnp.ndarray:
+    """arr (P, ...) ← row at position idx where mask (all unbatched;
+    vmapped over lanes). Scatter or one-hot select per _SELECT_UPDATES."""
+    if not _SELECT_UPDATES:
+        return arr.at[idx].set(jnp.where(mask, row, arr[idx]))
+    sel = (jnp.arange(arr.shape[0], dtype=jnp.int32) == idx) & mask
+    sel = sel.reshape((arr.shape[0],) + (1,) * (arr.ndim - 1))
+    return jnp.where(sel, row, arr)
 
 
 class SearchState(NamedTuple):
@@ -236,9 +256,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     h1, h2 = _tt_mod.hash_board(
         b.board, us, b.ep, b.castling, b.extra, variant
     )
-    phash = s.phash.at[ply].set(
-        jnp.where(enter, jnp.stack([h1, h2]), s.phash[ply])
-    )
+    phash = _row_set(s.phash, ply, jnp.stack([h1, h2]), enter)
     ks = jnp.arange(s.phash.shape[0], dtype=jnp.int32)
     chain_ok = (b.halfmove - s.halfmove[ks]) == (ply - ks)
     repet_path = jnp.any(
@@ -338,18 +356,19 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         tm_at = jnp.argmax(gen_moves == tt_move)
         tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move) & ~in_qs
         m0 = gen_moves[0]
-        gen_moves = gen_moves.at[jnp.where(tm_present, tm_at, 0)].set(
-            jnp.where(tm_present, m0, gen_moves[0])
-        )
+        # dynamic-index swap routed through _row_set so the
+        # SELECT_UPDATES experiment covers this scatter too (the index-0
+        # write below is static — not a dynamic-update-slice)
+        gen_moves = _row_set(gen_moves, tm_at, m0, tm_present)
         gen_moves = gen_moves.at[0].set(
             jnp.where(tm_present, tt_move, gen_moves[0])
         )
 
     def row_upd(arr, val, mask):
-        return arr.at[ply].set(jnp.where(mask, val, arr[ply]))
+        return _row_set(arr, ply, val, mask)
 
-    moves = s.moves.at[jnp.minimum(ply, s.moves.shape[0] - 1)].set(
-        jnp.where(expand, gen_moves, s.moves[jnp.minimum(ply, s.moves.shape[0] - 1)])
+    moves = _row_set(
+        s.moves, jnp.minimum(ply, s.moves.shape[0] - 1), gen_moves, expand
     )
     # QS nodes expand only the noisy prefix of the sorted move list
     count = row_upd(s.count, jnp.where(in_qs, gen_noisy, gen_count), expand)
@@ -409,23 +428,19 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     better = ret_m & (~at_root) & (~was_illegal) & (v > best[parent])
     fold = ret_m & ~at_root
 
-    best = best.at[parent].set(jnp.where(better, v, best[parent]))
-    best_move = best_move.at[parent].set(jnp.where(better, tried, best_move[parent]))
-    alpha = alpha.at[parent].set(
-        jnp.where(fold, jnp.maximum(alpha[parent], best[parent]), alpha[parent])
+    best = _row_set(best, parent, v, better)
+    best_move = _row_set(best_move, parent, tried, better)
+    alpha = _row_set(
+        alpha, parent, jnp.maximum(alpha[parent], best[parent]), fold
     )
-    searched = searched.at[parent].set(
-        searched[parent] + jnp.where(fold & ~was_illegal, 1, 0)
+    searched = _row_set(
+        searched, parent, searched[parent] + 1, fold & ~was_illegal
     )
     # pv[parent] = tried + pv[ply]
     new_pv_row = jnp.concatenate([tried[None], s.pv[ply][:-1]])
-    pv = s.pv.at[parent].set(jnp.where(better, new_pv_row, s.pv[parent]))
-    pv_len = pv_len.at[parent].set(
-        jnp.where(
-            better,
-            jnp.minimum(pv_len[ply] + 1, s.pv.shape[-1]),
-            pv_len[parent],
-        )
+    pv = _row_set(s.pv, parent, new_pv_row, better)
+    pv_len = _row_set(
+        pv_len, parent, jnp.minimum(pv_len[ply] + 1, s.pv.shape[-1]), better
     )
     # root: record and park (ret, not best[0] — ret carries the
     # mate/stalemate value when the root had no legal moves)
@@ -459,14 +474,12 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     k_upd = try_m & cutoff & c_quiet
     k0 = s.killers[ply, 0]
     new_row = jnp.stack([cause, jnp.where(cause == k0, s.killers[ply, 1], k0)])
-    killers = s.killers.at[ply].set(
-        jnp.where(k_upd & (cause != k0), new_row, s.killers[ply])
-    )
+    killers = _row_set(s.killers, ply, new_row, k_upd & (cause != k0))
     h_idx = jnp.clip(cause, 0) & 4095
     dl = jnp.maximum(s.depth_limit - ply, 0)
-    h_w = jnp.where(k_upd, jnp.minimum(dl * dl + 1, 1024), 0)
-    hist = s.hist.at[h_idx].set(
-        jnp.minimum(s.hist[h_idx] + h_w, 1 << 20)
+    h_w = jnp.minimum(dl * dl + 1, 1024)
+    hist = _row_set(
+        s.hist, h_idx, jnp.minimum(s.hist[h_idx] + h_w, 1 << 20), k_upd
     )
 
     # finished node value: best, or mate/stalemate when no legal child.
@@ -491,25 +504,19 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     child = make_move(parent_b, jnp.maximum(move, 0), variant)
     nply = jnp.minimum(ply + 1, s.board.shape[0] - 1)
 
-    midx = midx.at[ply].add(jnp.where(advance, 1, 0))
-    board = s.board.at[nply].set(jnp.where(advance, child.board, s.board[nply]))
-    stm = s.stm.at[nply].set(jnp.where(advance, child.stm, s.stm[nply]))
-    ep = s.ep.at[nply].set(jnp.where(advance, child.ep, s.ep[nply]))
-    castling = s.castling.at[nply].set(
-        jnp.where(advance, child.castling, s.castling[nply])
-    )
-    halfmove = s.halfmove.at[nply].set(
-        jnp.where(advance, child.halfmove, s.halfmove[nply])
-    )
-    extra_st = s.extra.at[nply].set(
-        jnp.where(advance, child.extra, s.extra[nply])
-    )
+    midx = _row_set(midx, ply, midx[ply] + 1, advance)
+    board = _row_set(s.board, nply, child.board, advance)
+    stm = _row_set(s.stm, nply, child.stm, advance)
+    ep = _row_set(s.ep, nply, child.ep, advance)
+    castling = _row_set(s.castling, nply, child.castling, advance)
+    halfmove = _row_set(s.halfmove, nply, child.halfmove, advance)
+    extra_st = _row_set(s.extra, nply, child.extra, advance)
     if nnue.is_board768(params) and variant != "atomic":
         codes, sqs, signs = move_piece_changes(
             parent_b, jnp.maximum(move, 0), variant
         )
         child_acc = nnue.apply_acc_updates_768(params, s.acc[ply], codes, sqs, signs)
-        acc = s.acc.at[nply].set(jnp.where(advance, child_acc, s.acc[nply]))
+        acc = _row_set(s.acc, nply, child_acc, advance)
     else:
         acc = s.acc
 

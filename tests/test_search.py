@@ -153,6 +153,50 @@ def test_resumable_matches_oneshot(params):
     assert seg["done"].all()
 
 
+@pytest.mark.slow
+def test_select_updates_mode_bit_identical(params):
+    """FISHNET_TPU_SELECT_UPDATES=1 (one-hot selects instead of dynamic
+    row scatters — the docs/tpu-hang.md device-fault candidate fix) must
+    produce bit-identical results. Runs in a subprocess because the flag
+    is read at import."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    if not nnue.is_board768(params):
+        pytest.skip("one feature set is enough")
+    prog = (
+        "import sys, json; sys.path.insert(0, '.')\n"
+        "import tools.force_cpu\n"
+        "import numpy as np, jax\n"
+        "from fishnet_tpu.chess import Position\n"
+        "from fishnet_tpu.models import nnue\n"
+        "from fishnet_tpu.ops.board import from_position, stack_boards\n"
+        "from fishnet_tpu.ops.search import search_batch_jit\n"
+        "p = nnue.init_params(jax.random.PRNGKey(0), l1=32, h1=8, h2=8,"
+        " feature_set='board768')\n"
+        "b = [from_position(Position.from_fen("
+        "'r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1'))]\n"
+        "roots = stack_boards(b * 8)\n"
+        "out = search_batch_jit(p, roots, 2, 20000, max_ply=4)\n"
+        "print(json.dumps({k: np.asarray(v).tolist() for k, v in out.items()"
+        " if k in ('score', 'move', 'nodes', 'pv_len')}))\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for flag in ("", "1"):
+        env = dict(os.environ)
+        env["FISHNET_TPU_SELECT_UPDATES"] = flag
+        r = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            cwd=repo, env=env, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        results.append(json.loads(r.stdout.splitlines()[-1]))
+    assert results[0] == results[1]
+
+
 def test_resumable_deadline_stops_early(params):
     # an already-passed deadline stops after one segment; unfinished lanes
     # report done=False so callers ignore their scores
