@@ -36,7 +36,7 @@ from .board import (
     node_rules,
 )
 from .movegen import generate_moves
-from .search import DRAW, ILLEGAL, INF, MATE
+from .search import DRAW, ILLEGAL, INF, MATE, NULL_R, _PRUNING
 
 
 @functools.lru_cache(maxsize=8)
@@ -105,7 +105,15 @@ class _Oracle:
         self.killers = np.full((max_ply + 2, 2), -1, np.int32)
         self.hist = np.zeros(4096, np.int32)
 
-    def search(self, b: Board, acc, ply: int, alpha: int, beta: int) -> int:
+    def search(self, b: Board, acc, ply: int, alpha: int, beta: int,
+               depth_left: int | None = None,
+               from_null: bool = False) -> int:
+        """depth_left: per-node remaining depth (root: self.depth); None
+        derives the pre-reduction value — kept for the depth==ply-derived
+        callers in older tests. from_null: this node was reached by a
+        null move (mirrors the device's parent null_st == 2)."""
+        if depth_left is None:
+            depth_left = self.depth - ply
         ops = self.ops
         (illegal, checked, val, moves, count, noisy, h1, h2,
          term_kind) = ops["classify"](
@@ -115,7 +123,6 @@ class _Oracle:
         )
         if ply > 0 and bool(illegal):
             return ILLEGAL
-        depth_left = self.depth - ply
         over_budget = self.nodes >= self.budget
         self.nodes += 1
         halfmove = int(b.halfmove)
@@ -131,9 +138,8 @@ class _Oracle:
         in_qs = depth_left <= 0
         stack_full = ply >= self.max_ply
 
-        leaf_val = DRAW if (fifty or repet) else max(
-            min(int(val), MATE - 1000), -(MATE - 1000)
-        )
+        static_val = max(min(int(val), MATE - 1000), -(MATE - 1000))
+        leaf_val = DRAW if (fifty or repet) else static_val
         kind = int(term_kind)
         vterm = kind != TERM_NONE
         if vterm:
@@ -163,17 +169,75 @@ class _Oracle:
         cut = False
         best_move = -1
         board_np = np.asarray(b.board)
+        # null-move eligibility, mirroring ops/search.py's nmp_ok bit for
+        # bit (antichess excluded there: captures are forced, so passing
+        # proves nothing)
+        nmp_ok = False
+        if _PRUNING and self.variant != "antichess" and not in_qs:
+            base = int(b.stm) * 6
+            nonpawn = bool(
+                ((board_np >= base + 2) & (board_np <= base + 5)).any()
+            )
+            nmp_ok = (
+                depth_left >= 3
+                and not bool(checked)
+                and not from_null
+                and ply > 0
+                and static_val >= beta
+                and beta < MATE - 1000
+                and beta > -(MATE - 1000)
+                and nonpawn
+            )
         self.path.append((hh[0], hh[1], halfmove, ply))
         try:
+            if nmp_ok and not alpha >= beta:
+                # same position, opponent to move, ep cleared, halfmove
+                # clock reset (breaks repetition chains across the null),
+                # searched in the zero-width (beta-1, beta) window at
+                # reduced depth — exactly the device's null child
+                r = NULL_R + (1 if depth_left >= 7 else 0)
+                nb = Board(
+                    board=b.board, stm=jnp.int32(1 - int(b.stm)),
+                    ep=jnp.int32(-1), castling=b.castling,
+                    halfmove=jnp.int32(0), extra=b.extra,
+                )
+                nv = self.search(
+                    nb, acc, ply + 1, -beta, 1 - beta,
+                    max(depth_left - 1 - r, 0), from_null=True,
+                )
+                if nv != ILLEGAL and -nv >= beta and -nv < MATE - 1000:
+                    return -nv
             for i in range(n):
                 if alpha >= beta:
                     cut = True
                     break
                 mv = int(moves[i])
+                # late-move reduction, mirroring the device's lmr_ok
+                red = 0
+                if _PRUNING and not in_qs:
+                    mto = (mv >> 6) & 63
+                    quiet = ((mv >> 15) & 1) == 1 or (
+                        int(board_np[mto]) == 0 and ((mv >> 12) & 7) == 0
+                    )
+                    if (depth_left >= 3 and i >= 3 and quiet
+                            and not bool(checked)):
+                        red = 2 if i >= 8 else 1
                 cb, cacc = ops["child"](self.p, b, acc, jnp.int32(mv))
-                v = self.search(cb, cacc, ply + 1, -beta, -alpha)
+                v = self.search(
+                    cb, cacc, ply + 1, -beta, -alpha,
+                    max(depth_left - 1 - red, 0),
+                )
                 if v == ILLEGAL:
                     continue
+                if red > 0 and -v > alpha:
+                    # reduced score beat alpha: re-search at full depth
+                    # (the device's RETURN-phase research re-push)
+                    v = self.search(
+                        cb, cacc, ply + 1, -beta, -alpha,
+                        max(depth_left - 1, 0),
+                    )
+                    if v == ILLEGAL:
+                        continue
                 searched += 1
                 if -v > best:
                     best = -v
@@ -193,7 +257,7 @@ class _Oracle:
                     k0 = int(self.killers[kp, 0])
                     if cause != k0:
                         self.killers[kp] = (cause, k0)
-                    dl = max(self.depth - ply, 0)
+                    dl = max(depth_left, 0)
                     w = min(dl * dl + 1, 1024)
                     idx = cause & 4095
                     self.hist[idx] = min(int(self.hist[idx]) + w, 1 << 20)

@@ -71,6 +71,32 @@ HIST_HM_SENTINEL = -32000
 # two modes are bit-identical (tests/test_search.py proves it on CPU).
 _SELECT_UPDATES = bool(os.environ.get("FISHNET_TPU_SELECT_UPDATES"))
 
+# FISHNET_TPU_NO_PRUNING=1: disable null-move pruning and late-move
+# reductions (debug/A-B lever; the oracle mirrors whatever mode is
+# active). Both cut the tree the reference's engine cuts it with
+# (Stockfish's search.cpp nullMove/LMR are the two biggest reducers
+# behind its depth-22 budgets — reference src/api.rs:275-281 sends
+# depth 22 move jobs that are unreachable by plain alpha-beta):
+# - null move: at a non-PV-critical node whose static eval already
+#   beats beta, give the opponent a free move at reduced depth; if the
+#   score STILL comes back >= beta, the node fails high without
+#   expanding a single real child.
+# - LMR: late, quiet, unchecked moves search at reduced depth first and
+#   only re-search at full depth when the reduced result beats alpha.
+_PRUNING = not os.environ.get("FISHNET_TPU_NO_PRUNING")
+NULL_R = 2  # base null-move depth reduction (+1 at depth_left >= 7)
+
+
+def _is_quiet(move: jnp.ndarray, board_row: jnp.ndarray) -> jnp.ndarray:
+    """Non-capture, non-promotion move (drops count as quiet; en passant
+    reads as quiet, which only costs ordering). Shared by the killer/
+    history credit and the LMR reduction test so the two paths can never
+    disagree on what 'quiet' means; move must be >= 0 (masked upstream)."""
+    to = jnp.clip((move >> 6) & 63, 0, 63)
+    return (((move >> 15) & 1) == 1) | (
+        (board_row[to] == 0) & (((move >> 12) & 7) == 0)
+    )
+
 
 def _row_set(arr: jnp.ndarray, idx, row, mask) -> jnp.ndarray:
     """arr (P, ...) ← row at position idx where mask (all unbatched;
@@ -96,6 +122,14 @@ class SearchState(NamedTuple):
     moves: jnp.ndarray  # (B, P, MAX_MOVES) int32
     count: jnp.ndarray  # (B, P)
     midx: jnp.ndarray  # (B, P)
+    # per-node remaining depth (root row = lane depth limit; children get
+    # parent-1 minus any null-move/LMR reduction on push). Replaces the
+    # lane-global depth_limit - ply derivation so reductions can differ
+    # per node — the enabler for null-move pruning and LMR.
+    depth_left: jnp.ndarray  # (B, P+1)
+    null_st: jnp.ndarray  # (B, P) 0 none/spent, 1 pending, 2 in flight
+    last_red: jnp.ndarray  # (B, P) reduction applied to last pushed child
+    research: jnp.ndarray  # (B,) bool: re-push last child at full depth
     killers: jnp.ndarray  # (B, P, 2) killer-move slots per ply (-1 empty)
     hist: jnp.ndarray  # (B, 4096) from|to-indexed history counters
     searched: jnp.ndarray  # (B, P) legal children folded so far
@@ -192,6 +226,12 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         hist_halfmove=jnp.asarray(hist_halfmove, jnp.int32),
         moves=z(P, max_moves_for(variant), fill=-1),
         count=z(P), midx=z(P),
+        depth_left=jnp.concatenate(
+            [depth.astype(jnp.int32)[:, None], jnp.zeros((B, P), jnp.int32)],
+            axis=1,
+        ),
+        null_st=z(P), last_red=z(P),
+        research=z(dtype=jnp.bool_),
         killers=z(P, 2, fill=-1), hist=z(4096),
         searched=z(P),
         alpha=z(P, fill=-INF), alpha0=z(P, fill=-INF), beta=z(P, fill=INF),
@@ -239,7 +279,12 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # game end, all per the statically compiled variant (board.node_rules)
     illegal_raw, we_are_checked, term_kind = node_rules(b, variant)
     parent_illegal = (ply > 0) & illegal_raw
-    depth_left = s.depth_limit - ply
+    depth_left = s.depth_left[ply]
+    parent_ix = jnp.maximum(ply - 1, 0)
+    # this node was reached by a null move: its window is the parent's
+    # null-window (beta-1, beta) seen from this side — and it must not
+    # null-move again (two passes in a row search the parent's position)
+    parent_null = (ply > 0) & (s.null_st[jnp.minimum(parent_ix, s.null_st.shape[0] - 1)] == 2)
     over_budget = s.nodes >= s.node_budget
     fifty = b.halfmove >= 100
 
@@ -276,6 +321,13 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         hist_chain & (s.hist_hash[:, 0] == h1) & (s.hist_hash[:, 1] == h2)
     )
     repet = enter & (repet_path | repet_hist)
+    # window inherited from the parent (negamax flip); a null child runs
+    # the parent's zero-width null-window (beta-1, beta) instead
+    entry_alpha = jnp.where(ply == 0, s.root_alpha, -s.beta[parent_ix])
+    entry_beta = jnp.where(
+        ply == 0, s.root_beta,
+        jnp.where(parent_null, 1 - s.beta[parent_ix], -s.alpha[parent_ix]),
+    )
     # quiescence: past the nominal depth, keep expanding CAPTURES until
     # the position is quiet (gen_noisy == 0), the stack is full, or the
     # budget runs out — the standard horizon-effect fix, with stand-pat
@@ -295,6 +347,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     else:
         leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
     leaf_val = jnp.clip(leaf_val, -MATE + 1000, MATE - 1000)
+    static_val = leaf_val  # pre-draw-override eval (null-move eligibility)
     leaf_val = jnp.where(fifty | repet, DRAW, leaf_val)
 
     # variant-rule game end (3 checks, exploded king, hill, goal rank,
@@ -321,10 +374,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     )
     # stand-pat beta cutoff: in QS the static eval is already >= beta —
     # the opponent wouldn't enter this line; fail high immediately
-    stand_pat_cut = in_qs & (
-        leaf_val
-        >= jnp.where(ply == 0, s.root_beta, -s.alpha[jnp.maximum(ply - 1, 0)])
-    )
+    stand_pat_cut = in_qs & (leaf_val >= entry_beta)
     is_leaf |= stand_pat_cut
 
     # TT cutoff: treat as a leaf return with the stored score (never at
@@ -342,10 +392,12 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # Quiet positions only: a quiet static eval IS the node's QS value,
     # while a noisy leaf (budget/stack cutoff) stored as depth-0 EXACT
     # would later short-circuit a real QS expansion of the same position.
-    # (fifty draws excluded: they don't transpose)
+    # (fifty/repetition draws excluded: they don't transpose; variant
+    # terminals excluded: their ply-relative mate-range values must
+    # never be TT-stored)
     leaf_store = (
         enter & is_leaf & ~parent_illegal & ~use_tt & ~fifty & ~repet
-        & (gen_noisy == 0)
+        & ~vterm & (gen_noisy == 0)
     )
     store_mark = leaf_store
     store_val = jnp.where(leaf_store, leaf_val, 0)
@@ -374,9 +426,6 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     count = row_upd(s.count, jnp.where(in_qs, gen_noisy, gen_count), expand)
     midx = row_upd(s.midx, 0, expand)
     searched = row_upd(s.searched, 0, expand)
-    entry_alpha = jnp.where(
-        ply == 0, s.root_alpha, -s.beta[jnp.maximum(ply - 1, 0)]
-    )
     # stand-pat: in QS the node may decline every capture and keep the
     # static eval, so it floors both best and alpha
     qs_floor = in_qs & expand
@@ -386,13 +435,37 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         expand,
     )
     alpha0 = row_upd(s.alpha0, entry_alpha, expand)
-    beta = row_upd(
-        s.beta,
-        jnp.where(ply == 0, s.root_beta, -s.alpha[jnp.maximum(ply - 1, 0)]),
-        expand,
-    )
+    beta = row_upd(s.beta, entry_beta, expand)
     best = row_upd(s.best, jnp.where(qs_floor, leaf_val, -INF), expand)
     best_move = row_upd(s.best_move, -1, expand)
+    # null-move eligibility (Stockfish search.cpp nullMove conditions,
+    # minus the zugzwang verification search): interior node, depth to
+    # spare, not in check, not already inside a null subtree, static
+    # eval >= beta, non-mate window, and side to move still has a piece
+    # (pawn/king-only positions are where the null observation fails)
+    if _PRUNING and variant != "antichess":
+        # antichess excluded: captures are FORCED there, so passing is
+        # not "at least as bad as the best move" — the null observation
+        # that justifies the cutoff simply doesn't hold
+        us_base = us * 6
+        nonpawn = jnp.any(
+            (b.board >= us_base + 2) & (b.board <= us_base + 5)
+        )
+        nmp_ok = (
+            ~in_qs
+            & (depth_left >= 3)
+            & ~we_are_checked
+            & ~parent_null
+            & (ply > 0)
+            & (static_val >= entry_beta)
+            & (entry_beta < MATE - 1000)
+            & (entry_beta > -(MATE - 1000))
+            & nonpawn
+        )
+        null_st = row_upd(s.null_st, jnp.where(nmp_ok, 1, 0), expand)
+    else:
+        null_st = row_upd(s.null_st, 0, expand)
+    last_red = row_upd(s.last_red, 0, expand)
     incheck = row_upd(s.incheck, we_are_checked, enter)
     # leaf nodes must also zero pv_len: the fold at the parent reads
     # pv_len[child_ply], which would otherwise be a stale slot
@@ -425,17 +498,38 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     was_illegal = ret == ILLEGAL
     v = -ret
     tried = moves[parent, jnp.maximum(midx[parent] - 1, 0)]
-    better = ret_m & (~at_root) & (~was_illegal) & (v > best[parent])
+    # the child that just returned was the parent's null move: score it
+    # against beta only — a fail-high ends the parent (unproven-mate
+    # guard: never cut on a mate-range null score), a fail-low is simply
+    # discarded. Either way it folds into nothing: no best_move, no pv,
+    # no searched credit.
+    is_null_ret = ret_m & ~at_root & (null_st[parent] == 2)
+    null_cut = (
+        is_null_ret & ~was_illegal & (v >= beta[parent]) & (v < MATE - 1000)
+    )
+    # LMR re-search: the last child was depth-reduced and its reduced
+    # score beat alpha — discard the fold and re-push it at full depth
+    need_rs = (
+        ret_m & ~at_root & ~was_illegal & ~is_null_ret
+        & (last_red[parent] > 0) & (v > alpha[parent])
+    )
+    better = (
+        ret_m & (~at_root) & (~was_illegal) & (v > best[parent])
+        & ~is_null_ret & ~need_rs
+    )
     fold = ret_m & ~at_root
 
-    best = _row_set(best, parent, v, better)
+    best = _row_set(best, parent, v, better | null_cut)
     best_move = _row_set(best_move, parent, tried, better)
     alpha = _row_set(
         alpha, parent, jnp.maximum(alpha[parent], best[parent]), fold
     )
     searched = _row_set(
-        searched, parent, searched[parent] + 1, fold & ~was_illegal
+        searched, parent, searched[parent] + 1,
+        fold & ~was_illegal & ~is_null_ret & ~need_rs,
     )
+    null_st = _row_set(null_st, parent, 0, is_null_ret)
+    research = jnp.where(ret_m, need_rs, s.research)
     # pv[parent] = tried + pv[ply]
     new_pv_row = jnp.concatenate([tried[None], s.pv[ply][:-1]])
     pv = _row_set(s.pv, parent, new_pv_row, better)
@@ -458,25 +552,29 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     try_m = mode == MODE_TRYMOVE
     exhausted = midx[ply] >= count[ply]
     cutoff = alpha[ply] >= beta[ply]
-    finish = exhausted | cutoff
+    # a pending null move is tried BEFORE the first real move; an LMR
+    # re-push (research, set by RETURN this same step) re-enters the
+    # previous move at full depth and overrides finish — exhausted may
+    # already be true when the reduced move was the last one
+    re_push = try_m & research
+    do_null = try_m & ~re_push & (null_st[ply] == 1) & ~cutoff
+    finish = (exhausted | cutoff) & ~do_null & ~re_push
     advance = try_m & ~finish
+    normal_adv = advance & ~re_push & ~do_null
+    dl_node = s.depth_left[ply]
 
     # killer/history credit on fail-high: the quiet move that raised
     # alpha >= beta becomes killer slot 0 for this ply and earns a
     # depth²-weighted history bump (captures already order by MVV-LVA;
     # en-passant reads as quiet here, which only costs ordering)
     cause = best_move[ply]
-    cto = jnp.clip((cause >> 6) & 63, 0, 63)
-    c_quiet = (cause >= 0) & (
-        (((cause >> 15) & 1) == 1)  # drops are quiet by construction
-        | ((s.board[ply][cto] == 0) & (((cause >> 12) & 7) == 0))
-    )
+    c_quiet = (cause >= 0) & _is_quiet(cause, s.board[ply])
     k_upd = try_m & cutoff & c_quiet
     k0 = s.killers[ply, 0]
     new_row = jnp.stack([cause, jnp.where(cause == k0, s.killers[ply, 1], k0)])
     killers = _row_set(s.killers, ply, new_row, k_upd & (cause != k0))
     h_idx = jnp.clip(cause, 0) & 4095
-    dl = jnp.maximum(s.depth_limit - ply, 0)
+    dl = jnp.maximum(dl_node, 0)
     h_w = jnp.minimum(dl * dl + 1, 1024)
     hist = _row_set(
         s.hist, h_idx, jnp.minimum(s.hist[h_idx] + h_w, 1 << 20), k_upd
@@ -485,8 +583,11 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # finished node value: best, or mate/stalemate when no legal child.
     # QS nodes only tried captures — no legal capture is NOT mate; their
     # stand-pat floor in `best` already covers the quiet alternatives.
-    node_in_qs = (s.depth_limit - ply) <= 0
-    no_legal = (searched[ply] == 0) & ~node_in_qs
+    node_in_qs = dl_node <= 0
+    # best == -INF guards the count==0 + null-cutoff corner: a null-move
+    # fail-high set best without any legal child being searched, and the
+    # node must return that score, not a phantom mate/stalemate
+    no_legal = (searched[ply] == 0) & ~node_in_qs & (best[ply] == -INF)
     if variant == "antichess":
         # losing chess: the side with no moves left (stalemated or out of
         # pieces) WINS (host: AntichessPosition._variant_outcome)
@@ -495,16 +596,56 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
     fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
 
-    move = moves[ply, jnp.minimum(midx[ply], moves.shape[-1] - 1)]
+    m_ix = jnp.where(
+        re_push,
+        jnp.maximum(midx[ply] - 1, 0),
+        jnp.minimum(midx[ply], moves.shape[-1] - 1),
+    )
+    move = moves[ply, m_ix]
     parent_b = Board(
         board=s.board[ply], stm=s.stm[ply], ep=s.ep[ply],
         castling=s.castling[ply], halfmove=s.halfmove[ply],
         extra=s.extra[ply],
     )
     child = make_move(parent_b, jnp.maximum(move, 0), variant)
+    # late-move reduction: late, quiet, unchecked moves of a deep-enough
+    # node search 1 ply shallower (2 from move 8); RETURN re-pushes at
+    # full depth when the reduced score beats alpha
+    if _PRUNING:
+        m_quiet = _is_quiet(jnp.maximum(move, 0), s.board[ply])
+        lmr_ok = (
+            (dl_node >= 3) & (midx[ply] >= 3) & m_quiet
+            & ~incheck[ply] & ~node_in_qs
+        )
+        red = jnp.where(
+            lmr_ok, jnp.where(midx[ply] >= 8, 2, 1), 0
+        )
+        red = jnp.where(re_push | do_null, 0, red)
+        # the null child: same position, opponent to move, no ep, and a
+        # reset halfmove clock — which deliberately breaks the reversible
+        # repetition chain across the null (Stockfish's pliesFromNull)
+        child = Board(
+            board=jnp.where(do_null, parent_b.board, child.board),
+            stm=jnp.where(do_null, 1 - parent_b.stm, child.stm),
+            ep=jnp.where(do_null, -1, child.ep),
+            castling=jnp.where(do_null, parent_b.castling, child.castling),
+            halfmove=jnp.where(do_null, 0, child.halfmove),
+            extra=jnp.where(do_null, parent_b.extra, child.extra),
+        )
+        null_r = NULL_R + jnp.where(dl_node >= 7, 1, 0)
+        child_dl = jnp.maximum(
+            jnp.where(do_null, dl_node - 1 - null_r, dl_node - 1 - red), 0
+        )
+    else:
+        red = jnp.int32(0)
+        child_dl = jnp.maximum(dl_node - 1, 0)
     nply = jnp.minimum(ply + 1, s.board.shape[0] - 1)
 
-    midx = _row_set(midx, ply, midx[ply] + 1, advance)
+    midx = _row_set(midx, ply, midx[ply] + 1, normal_adv)
+    null_st = _row_set(null_st, ply, 2, do_null)
+    last_red = _row_set(last_red, ply, red, advance)
+    research = jnp.where(try_m, jnp.bool_(False), research)
+    depth_left = _row_set(s.depth_left, nply, child_dl, advance)
     board = _row_set(s.board, nply, child.board, advance)
     stm = _row_set(s.stm, nply, child.stm, advance)
     ep = _row_set(s.ep, nply, child.ep, advance)
@@ -515,15 +656,18 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         codes, sqs, signs = move_piece_changes(
             parent_b, jnp.maximum(move, 0), variant
         )
+        if _PRUNING:
+            # a null move changes no pieces: zeroed slots make the
+            # incremental update an exact no-op (code 0 → no-op)
+            codes = jnp.where(do_null, 0, codes)
+            signs = jnp.where(do_null, 0, signs)
         child_acc = nnue.apply_acc_updates_768(params, s.acc[ply], codes, sqs, signs)
         acc = _row_set(s.acc, nply, child_acc, advance)
     else:
         acc = s.acc
 
     ret = jnp.where(try_m & finish, fin_val, ret)
-    ret_depth = jnp.where(
-        try_m & finish, s.depth_limit - ply, ret_depth
-    )
+    ret_depth = jnp.where(try_m & finish, dl_node, ret_depth)
     mode = jnp.where(
         try_m, jnp.where(finish, MODE_RETURN, MODE_ENTER), mode
     )
@@ -534,6 +678,8 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         extra=extra_st, phash=phash,
         hist_hash=s.hist_hash, hist_halfmove=s.hist_halfmove,
         moves=moves, count=count, midx=midx,
+        depth_left=depth_left, null_st=null_st, last_red=last_red,
+        research=research,
         killers=killers, hist=hist,
         searched=searched,
         alpha=alpha, alpha0=alpha0, beta=beta, best=best, best_move=best_move,
@@ -647,17 +793,27 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
                 store_mask,
             )
 
-            # ---- probe lanes about to enter a node (mode == ENTER)
+            # ---- probe lanes about to enter a node (mode == ENTER);
+            # the probe window must match the window ENTER will give the
+            # node — incl. the zero-width null window for null children,
+            # or stored LOWER bounds inside [1-beta_p, -alpha_p) would
+            # miss valid null-search fail-high cutoffs
             enter = s.mode == MODE_ENTER
             parent = jnp.maximum(s.ply - 1, 0)
+            pnull = (s.ply > 0) & (_gather_ply(s.null_st, parent) == 2)
             a_w = jnp.where(
                 s.ply == 0, s.root_alpha, -_gather_ply(s.beta, parent)
             )
             b_w = jnp.where(
-                s.ply == 0, s.root_beta, -_gather_ply(s.alpha, parent)
+                s.ply == 0, s.root_beta,
+                jnp.where(
+                    pnull,
+                    1 - _gather_ply(s.beta, parent),
+                    -_gather_ply(s.alpha, parent),
+                ),
             )
             usable, score, _mv, order_mv = _tt_mod.probe(
-                t, h1, h2, s.depth_limit - s.ply, a_w, b_w,
+                t, h1, h2, _gather_ply(s.depth_left, s.ply), a_w, b_w,
                 deep_bounds=deep_tt,
             )
             usable &= enter

@@ -167,11 +167,17 @@ def probe(tt: TTable, h1, h2, depth_left, alpha, beta,
     # the depth-d value of the node. The search's value at remaining depth
     # d' < d is a DIFFERENT number (quiescence truncates differently), and
     # a deeper bound does not bound it — substituting deeper values is what
-    # made TT-enabled root scores drift from the plain search. With exact
-    # matching every cutoff is a true bound on the same-depth value, so the
-    # root score is bit-identical with or without the table (determinism is
-    # a feature for analysis: same job → same output regardless of batch
-    # composition). Deeper entries still help via the ordering move.
+    # made TT-enabled root scores drift hardest from the plain search.
+    # Deeper entries still help via the ordering move.
+    #
+    # Determinism caveat: with null-move pruning + LMR active (the
+    # default since round 4), node values are window- and path-dependent
+    # (a reduced late move is skipped or re-searched depending on alpha;
+    # a null child can't null-move again), so TT cutoffs can shift root
+    # scores a little versus the plain search — exactly as they do in
+    # Stockfish, whose persistent hash the reference inherits
+    # (tests/test_tt.py bounds the drift). Bit-exact TT-on-vs-off scores
+    # hold only under FISHNET_TPU_NO_PRUNING=1.
     if deep_bounds:
         # the reference rule: any at-least-as-deep entry cuts (EXACT
         # included — a deeper exact value is the strongest hit of all)

