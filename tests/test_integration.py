@@ -159,8 +159,9 @@ def test_move_job_on_tpu_flavor(server):
 
     engine = TpuEngine(max_depth=2)
     # move jobs carry a hard 7 s deadline (src/api.rs:163-168): pre-compile
-    # the 64-lane program so the deadline race is about search, not XLA
-    engine.warmup(buckets=(64,))
+    # the 64-lane program so the deadline race is about search, not XLA —
+    # deep=True because move jobs run the distinct deep-TT program
+    engine.warmup(buckets=(64,), deep=True)
     server.add_move_job("mvtpu001", START, ["e2e4", "e7e5"], level=3)
     py = PyEngine(max_depth=2)
 

@@ -117,6 +117,36 @@ def test_mate_in_one_found(engine):
     assert res.scores.best().kind == "mate" and res.scores.best().value == 1
 
 
+def test_time_apportionment():
+    """Per-position time is the chunk's shared wall-clock split by node
+    share (round-3 advisor flag: a uniform elapsed/len split misstates
+    per-position nps on lichess's display). Sums to the chunk elapsed;
+    implied nps is uniform across positions of one dispatch."""
+    times = TpuEngine._apportion_time(2.0, [100, 300, 0])
+    assert times == [0.5, 1.5, 0.0]
+    assert abs(sum(times) - 2.0) < 1e-9
+    # degenerate: no nodes anywhere → uniform split, still sums
+    assert TpuEngine._apportion_time(1.2, [0, 0]) == [0.6, 0.6]
+
+
+def test_skill_pick_weakens():
+    """skill_pick at full strength always takes the top move; at low
+    skill it samples weaker near-best moves (the engine's lichess skill
+    analog — validated at game level by tools/strength_ab.py --skill)."""
+    import random
+
+    from fishnet_tpu.engine.tpu import skill_pick
+
+    ranked = [(50, 0), (40, 1), (-20, 2), (-500, 3)]
+    assert skill_pick(ranked, 20, random.Random(1)) == (50, 0)
+    picks = {
+        skill_pick(ranked, -9, random.Random(s))[1] for s in range(200)
+    }
+    assert len(picks) > 1, "low skill never deviated from the top move"
+    # the hopeless move stays outside the 3×weakness acceptance window
+    assert 3 not in picks
+
+
 def test_move_job(engine):
     work = MoveWork(id="tpumv001", level=SkillLevel(8))
     positions = [

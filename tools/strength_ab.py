@@ -39,6 +39,15 @@ def main() -> int:
     ap.add_argument("--max-plies", type=int, default=160)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--label", default="net")
+    ap.add_argument("--skill", type=int, default=None,
+                    help="lichess move-job skill 1-8 for the NET side: "
+                         "root moves become lanes and the engine's "
+                         "weakness sampler picks (validates the skill "
+                         "model, reference src/api.rs:248-283)")
+    ap.add_argument("--opponent-skill", type=int, default=None,
+                    help="same, for the opponent side (requires "
+                         "--opponent-net for net-vs-net, or uses the "
+                         "same net)")
     args = ap.parse_args()
 
     from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
@@ -78,6 +87,47 @@ def main() -> int:
         )
         ms = np.asarray(out["move"])[: len(boards)]
         return [decode_uci(int(m)) if int(m) >= 0 else None for m in ms]
+
+    def device_moves_skill(positions, skill, p=None, depth=None, tag=""):
+        """Move-job-style picks: each position's legal root moves become
+        lanes (depth-1 search from the child), ranked, then sampled via
+        the engine's skill_pick — the exact weakening path move jobs use
+        (engine/tpu.py _move_job)."""
+        if not positions:
+            return []
+        from fishnet_tpu.client.wire import SkillLevel
+        from fishnet_tpu.engine.tpu import skill_pick
+
+        p = params if p is None else p
+        depth = args.depth if depth is None else depth
+        sf_skill = SkillLevel(skill).engine_skill_level
+        lane_pos, boards, legals = [], [], []
+        for gi, pos in enumerate(positions):
+            legal = pos.legal_moves()
+            legals.append(legal)
+            for m in legal:
+                lane_pos.append(gi)
+                boards.append(from_position(pos.push(m)))
+        # coarse 256-lane buckets: root-move lane counts vary every
+        # cycle, and each distinct shape is a fresh XLA compile
+        B = ((len(boards) + 255) // 256) * 256
+        roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
+        out = search_batch_jit(
+            p, roots, max(depth - 1, 0), 500_000, max_ply=depth + 3
+        )
+        scores = np.asarray(out["score"])
+        picks = []
+        k = 0
+        for gi, legal in enumerate(legals):
+            ranked = sorted(
+                ((-int(scores[k + j]), j) for j in range(len(legal))),
+                key=lambda t: (-t[0], t[1]),
+            )
+            k += len(legal)
+            r = random.Random(f"{args.seed}:{tag}:{gi}:{len(legal)}")
+            pick = skill_pick(ranked, sf_skill, r)
+            picks.append(legal[pick[1]].uci())
+        return picks
 
     opp_params = (
         nnue.load_params(args.opponent_net) if args.opponent_net else None
@@ -125,7 +175,7 @@ def main() -> int:
                 settle(g, None)
                 continue
             if pos.turn != g["net_color"]:
-                if opp_params is not None:
+                if opp_params is not None or args.opponent_skill is not None:
                     opp_turn.append(g)
                     continue
                 uci = py_move(pos)  # host-side PyEngine reply
@@ -134,12 +184,19 @@ def main() -> int:
                     continue
                 g["pos"] = pos.push_uci(uci)
                 g["plies"] += 1
-        # opponent-net replies (net-vs-net mode): one batched dispatch
-        for g, uci in zip(
-            opp_turn,
-            device_moves([g["pos"] for g in opp_turn],
-                         p=opp_params, depth=args.py_depth),
-        ):
+        # opponent device replies (net-vs-net / skill-vs-skill modes):
+        # one batched dispatch
+        if args.opponent_skill is not None:
+            opp_ucis = device_moves_skill(
+                [g["pos"] for g in opp_turn], args.opponent_skill,
+                p=opp_params, depth=args.py_depth, tag=f"opp{cycle}",
+            )
+        else:
+            opp_ucis = device_moves(
+                [g["pos"] for g in opp_turn], p=opp_params,
+                depth=args.py_depth,
+            )
+        for g, uci in zip(opp_turn, opp_ucis):
             if uci is None:
                 settle(g, None)
                 continue
@@ -151,7 +208,12 @@ def main() -> int:
             if g["live"] and g["pos"].outcome() is None
             and g["pos"].legal_moves() and g["pos"].turn == g["net_color"]
         ]
-        ucis = device_moves([g["pos"] for g in net_turn])
+        if args.skill is not None:
+            ucis = device_moves_skill(
+                [g["pos"] for g in net_turn], args.skill, tag=f"net{cycle}",
+            )
+        else:
+            ucis = device_moves([g["pos"] for g in net_turn])
         for g, uci in zip(net_turn, ucis):
             if uci is None:
                 settle(g, None)
