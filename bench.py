@@ -28,17 +28,89 @@ import time
 # attempted last because a hang there can wedge the tunnel for later stages
 STAGES = [(8, 2), (64, 2), (8, 3), (256, 4)]
 
+# Device stages run with FISHNET_TPU_SELECT_UPDATES=1 FIRST: the round-3
+# bisection (docs/tpu-hang.md) pinned the B>=16/max_ply>=4 hang/worker-crash
+# on a suspected miscompiled scatter, and the one-hot select mode is the
+# CPU-proven candidate fix. A stage that dies in select mode is retried once
+# in the default scatter mode, so the artifact records which compile path
+# (if any) works on the hardware.
+SELECT_FIRST = os.environ.get("BENCH_SELECT_FIRST", "1") != "0"
+
 
 def _hb(t0: float, msg: str) -> None:
     print(f"[bench {time.time() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
-def stage_main(B: int, depth: int, budget: int) -> None:
+# BASELINE.md benchmark-config position sets
+FENS_STANDARD = [
+    "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+    "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+    "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+    "rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8",
+    "r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10",
+    "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
+    "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
+    "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
+]
+# Chess960 starting arrays (X-FEN; castling via rook files — the device
+# castling rows store rook squares, so FRC is the same compiled program)
+FENS_960 = [
+    "bqnbrkrn/pppppppp/8/8/8/8/PPPPPPPP/BQNBRKRN w KQkq - 0 1",
+    "nrbqkbrn/pppppppp/8/8/8/8/PPPPPPPP/NRBQKBRN w KQkq - 0 1",
+    "rkbnnbqr/pppppppp/8/8/8/8/PPPPPPPP/RKBNNBQR w KQkq - 0 1",
+    "qrknnrbb/pppppppp/8/8/8/8/PPPPPPPP/QRKNNRBB w KQkq - 0 1",
+]
+FENS_VARIANT = {
+    "crazyhouse": [
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR[] w KQkq - 0 1",
+        "rnb1kbnr/ppp1pppp/8/3p4/3P4/8/PPPqPPPP/RNBQKBNR[Pp] w KQkq - 0 4",
+    ],
+    "threeCheck": [
+        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
+    ],
+}
+
+
+def _roots_for(B: int, variant: str, fen_set: str):
+    """B lane roots (+ multipv lane table when fen_set == 'multipv')."""
+    from fishnet_tpu.chess import Position
+    from fishnet_tpu.chess.variants import from_fen
+    from fishnet_tpu.ops.board import from_position, stack_boards
+
+    if fen_set == "960":
+        fens = FENS_960
+    elif fen_set == "variant":
+        fens = FENS_VARIANT[variant]
+    else:
+        fens = FENS_STANDARD
+    if variant == "standard":
+        positions = [Position.from_fen(f) for f in fens]
+    else:
+        positions = [from_fen(f, variant) for f in fens]
+    if fen_set == "multipv":
+        # BASELINE config 3: every legal root move of every position
+        # becomes a lane — the engine's multipv decomposition
+        boards = []
+        for p in positions:
+            for m in p.legal_moves():
+                boards.append(from_position(p.push(m)))
+        boards = boards[:B]
+        return stack_boards(boards + [boards[0]] * (B - len(boards)))
+    return stack_boards(
+        [from_position(positions[i % len(positions)]) for i in range(B)]
+    )
+
+
+def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
+               fen_set: str = "standard") -> None:
     """Child process: run one (B, depth) stage with phase heartbeats.
 
     On success prints exactly one stdout line: RESULT {json}."""
     t0 = time.time()
-    _hb(t0, f"stage B={B} depth={depth}: importing jax")
+    mode = "select" if os.environ.get("FISHNET_TPU_SELECT_UPDATES") else "scatter"
+    _hb(t0, f"stage B={B} depth={depth} variant={variant} set={fen_set} "
+            f"row_mode={mode}: importing jax")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -49,26 +121,10 @@ def stage_main(B: int, depth: int, budget: int) -> None:
     platform = jax.default_backend()
     _hb(t0, f"devices={jax.devices()} platform={platform}")
 
-    from fishnet_tpu.chess import Position
     from fishnet_tpu.models import nnue
-    from fishnet_tpu.ops.board import from_position, stack_boards
     from fishnet_tpu.ops import search as S
 
-    # a spread of real game positions (openings → endgames)
-    fens = [
-        "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
-        "r1bqkbnr/pppp1ppp/2n5/4p3/2B1P3/5N2/PPPP1PPP/RNBQK2R b KQkq - 3 3",
-        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
-        "rnbq1k1r/pp1Pbppp/2p5/8/2B5/8/PPP1NnPP/RNBQK2R w KQ - 1 8",
-        "r4rk1/1pp1qppp/p1np1n2/2b1p1B1/2B1P1b1/P1NP1N2/1PP1QPPP/R4RK1 w - - 0 10",
-        "8/2p5/3p4/KP5r/1R3p1k/8/4P1P1/8 w - - 0 1",
-        "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
-        "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
-    ]
-    positions = [Position.from_fen(f) for f in fens]
-    roots = stack_boards(
-        [from_position(positions[i % len(positions)]) for i in range(B)]
-    )
+    roots = _roots_for(B, variant, fen_set)
     params = nnue.init_params(jax.random.PRNGKey(0), l1=64, feature_set="board768")
     max_ply = depth + 1
     depth_arr = jnp.full((B,), depth, jnp.int32)
@@ -87,12 +143,12 @@ def stage_main(B: int, depth: int, budget: int) -> None:
     # compile each program explicitly so a compiler hang is distinguishable
     # from an execution hang in the heartbeat tail
     _hb(t0, "compile_start init_state")
-    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, "standard")
+    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, variant)
     jax.block_until_ready(state.board)
     _hb(t0, "compile_done init_state (and executed)")
     seg = 20_000
     _hb(t0, f"compile_start run_segment(seg={seg})")
-    lowered = S._run_segment_jit.lower(params, state, tt, seg, "standard")
+    lowered = S._run_segment_jit.lower(params, state, tt, seg, variant)
     _hb(t0, "  lowered")
     lowered.compile()
     _hb(t0, "compile_done run_segment")
@@ -100,7 +156,7 @@ def stage_main(B: int, depth: int, budget: int) -> None:
     _hb(t0, "exec_start warmup search")
     out = S.search_batch_resumable(
         params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt,
+        segment_steps=seg, tt=tt, variant=variant,
     )
     tt = out.pop("tt")
     jax.block_until_ready(out["nodes"])
@@ -110,7 +166,7 @@ def stage_main(B: int, depth: int, budget: int) -> None:
     t1 = time.perf_counter()
     out = S.search_batch_resumable(
         params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt,
+        segment_steps=seg, tt=tt, variant=variant,
     )
     out.pop("tt")
     jax.block_until_ready(out["nodes"])
@@ -128,6 +184,13 @@ def stage_main(B: int, depth: int, budget: int) -> None:
                 "nodes": total_nodes,
                 "dt": dt,
                 "platform": platform,
+                "variant": variant,
+                "fen_set": fen_set,
+                "row_mode": (
+                    "select"
+                    if os.environ.get("FISHNET_TPU_SELECT_UPDATES")
+                    else "scatter"
+                ),
             }
         ),
         flush=True,
@@ -135,16 +198,22 @@ def stage_main(B: int, depth: int, budget: int) -> None:
 
 
 def run_stage(B: int, depth: int, budget: int, timeout: float,
-              force_cpu: bool = False) -> dict | None:
+              force_cpu: bool = False, select: bool = False,
+              variant: str = "standard",
+              fen_set: str = "standard") -> dict | None:
     """Parent: launch one stage subprocess; return its RESULT or None."""
     import tempfile
 
     t0 = time.time()
     cmd = [sys.executable, os.path.abspath(__file__),
-           "--stage", str(B), str(depth), str(budget)]
+           "--stage", str(B), str(depth), str(budget), variant, fen_set]
     env = dict(os.environ)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+    if select:
+        env["FISHNET_TPU_SELECT_UPDATES"] = "1"
+    else:
+        env.pop("FISHNET_TPU_SELECT_UPDATES", None)
     # child stderr goes to a file, not a pipe: on timeout-kill a pipe's
     # contents are lost (TimeoutExpired.stderr is None on this platform),
     # and the heartbeat tail is most needed exactly then
@@ -161,7 +230,8 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
                 l for l in hb.read()[-4000:].splitlines(True)
                 if "experimental" not in l
             )
-            print(f"bench stage B={B} d={depth} TIMED OUT after "
+            print(f"bench stage B={B} d={depth} "
+                  f"mode={'select' if select else 'scatter'} TIMED OUT after "
                   f"{timeout:.0f}s; heartbeat tail:\n{tail}",
                   file=sys.stderr, flush=True)
             return None
@@ -214,17 +284,29 @@ def main() -> None:
 
     best = None  # result dict with max nps
     fails = 0
+    # the row-write mode that last worked on this device; start from the
+    # candidate-fix mode (SELECT_FIRST) and fall back per shape
+    good_mode: bool | None = None
     for b, d in stages:
         if time.time() - t_start > total_budget - stage_timeout:
             print("bench: total budget nearly spent; stopping ramp",
                   file=sys.stderr, flush=True)
             break
-        res = run_stage(b, d, BUDGET, stage_timeout)
+        preferred = SELECT_FIRST if good_mode is None else good_mode
+        modes = [preferred, not preferred]  # retry a dead shape in the other mode
+        res = None
+        for m in modes:
+            res = run_stage(b, d, BUDGET, stage_timeout, select=m)
+            if res is not None:
+                good_mode = m
+                break
+            if time.time() - t_start > total_budget - stage_timeout:
+                break
         if res is None:
             fails += 1
             if fails >= 2:
-                # two consecutive dead stages: the device (or tunnel) is
-                # gone; don't burn the rest of the budget on it
+                # two consecutive dead shapes (both modes): the device (or
+                # tunnel) is gone; don't burn the rest of the budget on it
                 print("bench: two consecutive stage failures; stopping ramp",
                       file=sys.stderr, flush=True)
                 break
@@ -232,6 +314,44 @@ def main() -> None:
         fails = 0
         if best is None or res["nps"] > best["nps"]:
             best = res
+
+    # BASELINE.md config matrix (configs 3-5): multipv-5 decomposition,
+    # chess960, crazyhouse + threeCheck — each its own subprocess in the
+    # mode that worked for the headline ramp. Results go to
+    # bench_matrix.json (the driver consumes only the single stdout line).
+    matrix = {}
+    if best is not None and os.environ.get("BENCH_MATRIX", "1") != "0":
+        cfg_stages = [
+            ("cfg3_multipv5", 128, 3, "standard", "multipv"),
+            ("cfg4_chess960", 64, 3, "standard", "960"),
+            ("cfg5_crazyhouse", 64, 3, "crazyhouse", "variant"),
+            ("cfg5_threecheck", 64, 3, "threeCheck", "variant"),
+        ]
+        for name, b, d, var, fset in cfg_stages:
+            remaining = total_budget - (time.time() - t_start)
+            if remaining < 120.0:
+                print(f"bench: skipping {name} (budget spent)",
+                      file=sys.stderr, flush=True)
+                matrix[name] = None
+                continue
+            res = run_stage(
+                b, d, BUDGET, min(stage_timeout, remaining),
+                select=(good_mode if good_mode is not None else SELECT_FIRST),
+                variant=var, fen_set=fset,
+            )
+            matrix[name] = res
+            print(f"bench config {name}: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+    if matrix:
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "bench_matrix.json"), "w") as f:
+                json.dump({"headline": best, "configs": matrix}, f, indent=1)
+        except OSError as e:
+            print(f"bench: could not write bench_matrix.json: {e}",
+                  file=sys.stderr, flush=True)
 
     label = ""
     if best is None:
@@ -271,7 +391,8 @@ def main() -> None:
                 "metric": (
                     f"batched alpha-beta+NNUE nodes/sec/chip "
                     f"(B={best['B']}, depth={best['depth']}, "
-                    f"platform={best['platform']}){label}"
+                    f"platform={best['platform']}, "
+                    f"row_mode={best.get('row_mode', 'scatter')}){label}"
                 ),
                 "value": round(best["nps"]),
                 "unit": "nodes/sec",
@@ -285,6 +406,9 @@ if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--stage":
         if os.environ.get("BENCH_FORCE_CPU"):
             from tools import force_cpu  # noqa: F401  (deregisters axon)
-        stage_main(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+        stage_main(
+            int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+            *(sys.argv[5:7] or ()),
+        )
     else:
         main()

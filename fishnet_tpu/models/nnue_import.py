@@ -35,6 +35,16 @@ the public nnue-pytorch trainer and read by Stockfish 15/16:
     * Quantization scales: FT 127 (QA), hidden weights 64 (QB),
       output scale 16; dequantized here to float32.
 
+SCOPE — eval-parity tooling, not the search path. Imported HalfKAv2_hm
+nets evaluate positions (engine compat path, eval A/Bs, label
+generation) but pay a full accumulator refresh per search step, because
+"incremental" HalfKAv2_hm cannot win inside a lockstep vmapped step: a
+king move forces a full per-perspective refresh, a vmapped `cond`
+compiles to a select that EXECUTES both branches, so every step would
+pay the masked 64-gather refresh anyway — exactly what the full-refresh
+path already costs. board768 (no king buckets, every move a ≤4-feature
+delta) is the search feature set by design; see README "Evaluation".
+
 Anything that doesn't match this layout (different sizes, unknown
 section lengths) raises UnsupportedNnueFormat rather than misparsing.
 There are no real `.nnue` files in this build environment, so the parser
