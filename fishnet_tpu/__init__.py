@@ -3,7 +3,7 @@
 A brand-new implementation of the capabilities of fishnet (the lichess.org
 distributed analysis client) with a first-class TPU engine: batched legal
 move generation, quantized NNUE evaluation, and lockstep alpha-beta search
-as JAX/XLA/Pallas programs, sharded across TPU meshes.
+as JAX/XLA programs, sharded across TPU meshes.
 """
 
 __version__ = "0.1.0"

@@ -79,7 +79,14 @@ class Config:
     engine_path: Optional[str] = None  # external Stockfish (Official flavor)
     variant_engine_path: Optional[str] = None  # external Fairy-Stockfish
     tpu_weights: Optional[str] = None
-    tpu_depth: int = 6
+    # analysis depth cap. Deepening is ALSO governed per position by the
+    # server node budget and the chunk deadline (engine/tpu.py stops
+    # iterating when either runs out), so this cap only binds when budget
+    # remains — raised 6 → 8 in round 4 when null-move pruning + LMR cut
+    # the per-depth cost (~2 plies deeper at equal node spend, the
+    # standard NMP+LMR yield); raise further once on-TPU time-to-depth
+    # tables exist (tools/depth_table.py)
+    tpu_depth: int = 8
     user_backlog: Optional[float] = None
     system_backlog: Optional[float] = None
     max_backoff: float = 30.0
@@ -183,7 +190,7 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.engine_path = pick(args.engine_path, "engine_path")
     cfg.variant_engine_path = pick(args.variant_engine_path, "variant_engine_path")
     cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
-    cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", 6))
+    cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", 8))
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

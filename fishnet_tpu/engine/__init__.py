@@ -1,4 +1,4 @@
-"""Engine backends: TPU (JAX/Pallas), UCI subprocess, and pure-Python CPU."""
+"""Engine backends: TPU (JAX/XLA), UCI subprocess, and pure-Python CPU."""
 from .base import Engine, EngineError, EngineFactory
 
 __all__ = ["Engine", "EngineError", "EngineFactory"]

@@ -27,12 +27,13 @@ def batched_forward(params: nnue.NnueParams, boards: jnp.ndarray,
                     stms: jnp.ndarray) -> jnp.ndarray:
     """(B, 64) boards, (B,) stms → (B,) centipawn scores.
 
-    FISHNET_TPU_PALLAS=1 routes board768 nets through the fused Pallas
-    kernel (ops/pallas_nnue.py); default is the XLA path."""
-    from ..ops import pallas_nnue
-
-    if pallas_nnue.is_enabled() and nnue.is_board768(params):
-        return pallas_nnue.evaluate_batch_trainable(params, boards, stms)
+    Plain XLA: the eval stack is a few small matmuls + clipped ReLUs that
+    XLA fuses on its own. A hand-written Pallas fusion of this stack
+    lived here for rounds 2-3 but never reached hardware (the TPU tunnel
+    was down whenever it was ready) and only ever ran interpreted in
+    training — retired per the round-3 verdict ("measure on hardware or
+    delete"); see git history (ops/pallas_nnue.py) to resurrect it if a
+    measured win ever justifies it."""
     return jax.vmap(nnue.evaluate, in_axes=(None, 0, 0))(params, boards, stms)
 
 
