@@ -190,7 +190,7 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.engine_path = pick(args.engine_path, "engine_path")
     cfg.variant_engine_path = pick(args.variant_engine_path, "variant_engine_path")
     cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
-    cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", 8))
+    cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", Config.tpu_depth))
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

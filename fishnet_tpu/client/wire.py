@@ -19,7 +19,7 @@ class EngineFlavor(enum.Enum):
 
     The reference has Official (Stockfish) and MultiVariant (Fairy-Stockfish)
     (reference: src/assets.rs:124-137); this framework adds TPU, the batched
-    JAX/Pallas engine.
+    JAX/XLA engine.
     """
 
     OFFICIAL = "official"

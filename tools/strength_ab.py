@@ -53,6 +53,10 @@ def main() -> int:
     from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
     import numpy as np
 
+    from fishnet_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()  # lane-bucket programs persist across runs
+
     from fishnet_tpu.chess import Position
     from fishnet_tpu.engine.pyengine import MATE_VALUE, PySearch
     from fishnet_tpu.models import nnue
@@ -220,16 +224,26 @@ def main() -> int:
                 continue
             g["pos"] = g["pos"].push_uci(uci)
             g["plies"] += 1
-        if cycle % 20 == 0:
+        if cycle % 5 == 0 or cycle <= 3:
             done = sum(1 for g in games if not g["live"])
             print(
                 f"[{args.label}] cycle {cycle}: {done}/{args.games} games "
                 f"done, +{w} ={d} -{l}",
                 flush=True,
             )
+    n = max(args.games, 1)
+    score = (w + 0.5 * d) / n
+    # Wilson 95% interval on the score fraction (draws as half-wins):
+    # the standard interval for match results at these game counts
+    z = 1.96
+    mid = (score + z * z / (2 * n)) / (1 + z * z / n)
+    half = (
+        z * ((score * (1 - score) + z * z / (4 * n)) / n) ** 0.5
+        / (1 + z * z / n)
+    )
     print(
         f"[{args.label}] final: +{w} ={d} -{l} over {args.games} games, "
-        f"score {(w + 0.5 * d) / max(args.games, 1):.3f}"
+        f"score {score:.3f} (95% CI {mid - half:.3f}-{mid + half:.3f})"
     )
     return 0
 
