@@ -149,18 +149,31 @@ class _Oracle:
                 TERM_DRAW: DRAW,
             }[kind]
         count, noisy = int(count), int(noisy)
+        # futility pruning (mirrors ops/search.py bit for bit): frontier
+        # node with static eval a margin below alpha expands only the
+        # noisy prefix with the static eval as fail-soft floor
+        futile = False
+        if _PRUNING and not in_qs and not bool(checked) and ply > 0:
+            f_margin = 150 if depth_left == 1 else 300
+            futile = (
+                depth_left <= 2
+                and static_val + f_margin <= alpha
+                and alpha > -(MATE - 1000)
+                and alpha < MATE - 1000
+            )
+        qs_like = in_qs or futile
         is_leaf = (
             fifty or repet or vterm or over_budget or stack_full
-            or (in_qs and noisy == 0)
+            or (qs_like and noisy == 0)
         )
         if in_qs and leaf_val >= beta:  # stand-pat beta cutoff
             is_leaf = True
         if is_leaf:
             return leaf_val
 
-        n = noisy if in_qs else count
+        n = noisy if qs_like else count
         moves = np.asarray(moves)
-        if in_qs:
+        if qs_like:
             best = leaf_val  # stand-pat floors best and alpha
             alpha = max(alpha, leaf_val)
         else:
@@ -263,7 +276,10 @@ class _Oracle:
                     self.hist[idx] = min(int(self.hist[idx]) + w, 1 << 20)
         finally:
             self.path.pop()
-        if searched == 0 and not in_qs and not cut:
+        # best == -INF mirrors the device's no_legal guard: a futile node
+        # whose noisy children were all illegal still carries its static
+        # floor in `best` and must return it, not a phantom mate/stalemate
+        if searched == 0 and not in_qs and not cut and best == -INF:
             if self.variant == "antichess":
                 # the side with no moves (stalemated / out of pieces) WINS
                 return MATE - ply

@@ -71,12 +71,13 @@ HIST_HM_SENTINEL = -32000
 # two modes are bit-identical (tests/test_search.py proves it on CPU).
 _SELECT_UPDATES = bool(os.environ.get("FISHNET_TPU_SELECT_UPDATES"))
 
-# FISHNET_TPU_NO_PRUNING=1: disable null-move pruning and late-move
-# reductions (debug/A-B lever; the oracle mirrors whatever mode is
-# active). Both cut the tree the reference's engine cuts it with
-# (Stockfish's search.cpp nullMove/LMR are the two biggest reducers
-# behind its depth-22 budgets — reference src/api.rs:275-281 sends
-# depth 22 move jobs that are unreachable by plain alpha-beta):
+# FISHNET_TPU_NO_PRUNING=1: disable null-move pruning, late-move
+# reductions AND futility pruning (debug/A-B lever; the oracle mirrors
+# whatever mode is active). All three cut the tree the reference's
+# engine cuts it with (Stockfish's search.cpp nullMove/LMR/futility are
+# the biggest reducers behind its depth-22 budgets — reference
+# src/api.rs:275-281 sends depth 22 move jobs unreachable by plain
+# alpha-beta; futility itself lives at the ENTER phase below):
 # - null move: at a non-PV-critical node whose static eval already
 #   beats beta, give the opponent a free move at reduced depth; if the
 #   score STILL comes back >= beta, the node fails high without
@@ -368,9 +369,30 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         killers=s.killers[jnp.minimum(ply, s.killers.shape[0] - 1)],
         hist=s.hist,
     )
+    # futility pruning: at a frontier node (depth_left 1-2, not in check,
+    # non-mate window) whose static eval sits a margin below alpha, quiet
+    # moves cannot realistically raise alpha — expand only the noisy
+    # prefix, exactly the QS mechanics with the static eval as the
+    # fail-soft floor (static < alpha, so the floor never raises alpha).
+    # The same speculative unsoundness every real engine ships: skipped
+    # quiets are treated as searched-and-failed-low.
+    if _PRUNING:
+        f_margin = jnp.where(depth_left == 1, 150, 300)
+        futile = (
+            ~in_qs
+            & (depth_left <= 2)
+            & ~we_are_checked
+            & (ply > 0)
+            & (static_val + f_margin <= entry_alpha)
+            & (entry_alpha > -(MATE - 1000))
+            & (entry_alpha < MATE - 1000)
+        )
+    else:
+        futile = jnp.bool_(False)
+    qs_like = in_qs | futile  # expands noisy prefix only, static floor
     is_leaf = (
         fifty | repet | vterm | over_budget | stack_full
-        | (in_qs & (gen_noisy == 0))
+        | (qs_like & (gen_noisy == 0))
     )
     # stand-pat beta cutoff: in QS the static eval is already >= beta —
     # the opponent wouldn't enter this line; fail high immediately
@@ -406,7 +428,8 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # in QS, where the swap could pull a quiet move into the noisy prefix
     if tt_move is not None:
         tm_at = jnp.argmax(gen_moves == tt_move)
-        tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move) & ~in_qs
+        # ~qs_like: the swap could pull a quiet move into the noisy prefix
+        tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move) & ~qs_like
         m0 = gen_moves[0]
         # dynamic-index swap routed through _row_set so the
         # SELECT_UPDATES experiment covers this scatter too (the index-0
@@ -422,13 +445,15 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     moves = _row_set(
         s.moves, jnp.minimum(ply, s.moves.shape[0] - 1), gen_moves, expand
     )
-    # QS nodes expand only the noisy prefix of the sorted move list
-    count = row_upd(s.count, jnp.where(in_qs, gen_noisy, gen_count), expand)
+    # QS (and futile) nodes expand only the noisy prefix of the move list
+    count = row_upd(s.count, jnp.where(qs_like, gen_noisy, gen_count), expand)
     midx = row_upd(s.midx, 0, expand)
     searched = row_upd(s.searched, 0, expand)
     # stand-pat: in QS the node may decline every capture and keep the
-    # static eval, so it floors both best and alpha
-    qs_floor = in_qs & expand
+    # static eval, so it floors both best and alpha (futile nodes reuse
+    # the same floor; their static sits below alpha by construction, so
+    # only `best` actually moves — the fail-soft return value)
+    qs_floor = qs_like & expand
     alpha = row_upd(
         s.alpha,
         jnp.where(qs_floor, jnp.maximum(entry_alpha, leaf_val), entry_alpha),

@@ -27,7 +27,6 @@ import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 # mixed openings / middlegames / endgames, both colors to move
 FENS = [
@@ -83,7 +82,12 @@ def main() -> int:
     import shutil
 
     if args.engine is not None and not os.path.exists(args.engine):
-        args.engine = shutil.which(args.engine)  # bare command name on PATH
+        resolved = shutil.which(args.engine)  # bare command name on PATH
+        if resolved is None:
+            print(f"--engine {args.engine!r} not found (neither a file nor "
+                  "on PATH)", file=sys.stderr)
+            return 1
+        args.engine = resolved
     if args.engine is None:
         print(
             "BLOCKED: no engine binary available (this image bundles none; "
@@ -112,7 +116,7 @@ def main() -> int:
     for fen in FENS:
         try:
             sf = engine_eval_cp(args.engine, fen)
-        except RuntimeError as e:
+        except (RuntimeError, OSError, subprocess.TimeoutExpired) as e:
             print(f"engine failure on {fen}: {e}", file=sys.stderr)
             return 1
         if sf is None:
