@@ -82,11 +82,16 @@ class Config:
     # analysis depth cap. Deepening is ALSO governed per position by the
     # server node budget and the chunk deadline (engine/tpu.py stops
     # iterating when either runs out), so this cap only binds when budget
-    # remains — raised 6 → 8 in round 4 when null-move pruning + LMR cut
-    # the per-depth cost (~2 plies deeper at equal node spend, the
-    # standard NMP+LMR yield); raise further once on-TPU time-to-depth
-    # tables exist (tools/depth_table.py)
-    tpu_depth: int = 8
+    # remains — raised 6 → 8 → 12 in round 4 as the pruning stack grew
+    # (NMP + LMR, then frontier futility). The measured node table
+    # (docs/depth.md, tools/depth_table.py: EBF ≈ 2.8 with the full
+    # stack + TT) puts the reference's own per-position budgets
+    # (api.rs:214-233, ×6/7 overlap scaling) at budget-emergent depth
+    # ~9-10 (sf16) / ~10-11 (classical), so 12 lets the BUDGET bind —
+    # matching the reference, whose depth is likewise budget-emergent —
+    # while the deadline race still cuts off any iteration a slow
+    # backend can't afford.
+    tpu_depth: int = 12
     user_backlog: Optional[float] = None
     system_backlog: Optional[float] = None
     max_backoff: float = 30.0

@@ -125,7 +125,7 @@ class TpuEngine:
         self,
         params: Optional[nnue.NnueParams] = None,
         weights_path: Optional[str] = None,
-        max_depth: int = 8,  # production value flows from configure.tpu_depth
+        max_depth: int = 12,  # production value flows from configure.tpu_depth
         seed: int = 1234,
         tt_size_log2: int = 21,  # 2M slots ≈ 24 MiB HBM; 0 disables
     ) -> None:
