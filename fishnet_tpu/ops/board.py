@@ -104,6 +104,84 @@ def piece_type(code: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(code == 0, -1, (code - 1) % 6)
 
 
+def exclusive_cumsum_small(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Exclusive integer cumsum along a SMALL static axis via log2(n)
+    shift-adds (Hillis-Steele). Bit-identical to
+    `jnp.cumsum(x, axis) - x` for integer inputs; exists because XLA:TPU
+    lowers cumsum to reduce-window, which the round-4 device profile
+    showed dominating `is_attacked`/movegen at these tiny axis lengths."""
+    n = x.shape[axis]
+    acc = x
+    shift = 1
+    while shift < n:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (shift, 0)
+        sliced = jax.lax.slice_in_dim(acc, 0, n - shift, axis=axis)
+        acc = acc + jnp.pad(sliced, pad)
+        shift *= 2
+    return acc - x
+
+
+# RAY_DIRS order: E, N, NE, NW, W, S, SW, SE → orthogonal dirs 0,1,4,5
+_ORTHO_DIR = np.array([True, True, False, False, True, True, False, False])
+
+
+def attack_map(board64: jnp.ndarray, by_color: jnp.ndarray,
+               skip1=None, skip2=None) -> jnp.ndarray:
+    """(64,) bool: every square attacked by `by_color`, in one pass.
+
+    skip1/skip2 (optional square indices) are treated as EMPTY for slider
+    blocking — the castling test lifts the moving king and rook off the
+    board. Skipped squares never count as attackers either (they hold the
+    castler's own pieces, and a skipped square can never be ray-first).
+
+    Replaces per-square `is_attacked` queries in the search step: the
+    round-4 device profile showed the castling path's 14 vmapped
+    single-square queries costing ~930 us/step in serialized gather
+    fusions, while this whole-board form is elementwise logic over the
+    same (64, 8, 7) ray-piece tensor the move generator already gathers
+    (shared by XLA CSE). Unbatched; vmap for lanes.
+    """
+    rsq_t = jnp.asarray(T.RAYS)  # (64, 8, 7) static
+    rvalid = rsq_t >= 0
+    rpiece = board64[jnp.clip(rsq_t, 0)]  # same gather as movegen → CSE
+    rocc = (rpiece > 0) & rvalid
+    if skip1 is not None:
+        rocc &= rsq_t != skip1
+    if skip2 is not None:
+        rocc &= rsq_t != skip2
+    before = exclusive_cumsum_small(rocc.astype(jnp.int32), axis=2)
+    is_first = rocc & (before == 0)
+    # enemy slider sliding along this (symmetric) direction — elementwise
+    # piece-type tests, not a SLIDER_MASK value-gather
+    pt = piece_type(rpiece)
+    enemy = piece_color(rpiece) == by_color
+    ortho = jnp.asarray(_ORTHO_DIR)[None, :, None]
+    slider_ok = (pt == 4) | ((pt == 3) & ortho) | ((pt == 2) & ~ortho)
+    slider_hit = jnp.any(is_first & slider_ok & enemy, axis=(1, 2))
+
+    king_code = jnp.where(by_color == 0, T.W_KING, T.B_KING)
+    king_hit = jnp.any(rvalid[:, :, 0] & (rpiece[:, :, 0] == king_code), axis=1)
+
+    kt = jnp.asarray(T.KNIGHT_TARGETS)  # (64, 8) static
+    ktp = jnp.where(kt >= 0, board64[jnp.clip(kt, 0)], 0)
+    knight_code = jnp.where(by_color == 0, T.W_KNIGHT, T.B_KNIGHT)
+    knight_hit = jnp.any(ktp == knight_code, axis=1)
+
+    # pawns of by_color attacking sq sit on the squares a pawn of the
+    # *opposite* color on sq would attack
+    ps = jnp.where(
+        by_color == 0,
+        jnp.asarray(T.PAWN_CAPTURES[1]),
+        jnp.asarray(T.PAWN_CAPTURES[0]),
+    )  # (64, 2)
+    psp = jnp.where(ps >= 0, board64[jnp.clip(ps, 0)], 0)
+    pawn_code = jnp.where(by_color == 0, T.W_PAWN, T.B_PAWN)
+    pawn_hit = jnp.any(psp == pawn_code, axis=1)
+
+    return slider_hit | king_hit | knight_hit | pawn_hit
+
+
 def is_attacked(board64: jnp.ndarray, sq: jnp.ndarray, by_color: jnp.ndarray) -> jnp.ndarray:
     """Is `sq` attacked by `by_color` on `board64`? Single-square query used
     for check detection and castling-path tests; O(8 dirs × 7 steps) gathers.
@@ -112,8 +190,11 @@ def is_attacked(board64: jnp.ndarray, sq: jnp.ndarray, by_color: jnp.ndarray) ->
     valid = rays >= 0
     ray_pieces = jnp.where(valid, board64[jnp.clip(rays, 0)], 0)  # (8, 7)
     occupied = ray_pieces > 0
-    # first occupied step along each ray
-    before = jnp.cumsum(occupied, axis=1) - occupied.astype(jnp.int32)
+    # first occupied step along each ray. exclusive_cumsum_small instead of
+    # jnp.cumsum: XLA:TPU lowers cumsum to a reduce-window that cost
+    # ~230 us/step across this function's call sites in the round-4 device
+    # profile; 3 shifted adds are fused elementwise code.
+    before = exclusive_cumsum_small(occupied.astype(jnp.int32), axis=1)
     is_first = occupied & (before == 0)
     slider_ok = jnp.asarray(T.SLIDER_MASK)[
         jnp.arange(8)[:, None], ray_pieces
@@ -149,10 +230,8 @@ def king_square(board64: jnp.ndarray, color: jnp.ndarray) -> jnp.ndarray:
 
 
 def in_check(b: Board) -> jnp.ndarray:
-    ksq = king_square(b.board, b.stm)
-    return jnp.where(
-        ksq >= 0, is_attacked(b.board, jnp.maximum(ksq, 0), 1 - b.stm), False
-    )
+    king_code = jnp.where(b.stm == 0, T.W_KING, T.B_KING)
+    return jnp.any(attack_map(b.board, 1 - b.stm) & (b.board == king_code))
 
 
 # variant-terminal kinds, from the side to move's perspective
@@ -177,10 +256,18 @@ def node_rules(b: Board, variant: str = "standard"):
     them = 1 - us
     our_k = king_square(b.board, us)
     their_k = king_square(b.board, them)
-    our_k_c = jnp.maximum(our_k, 0)
     their_k_c = jnp.maximum(their_k, 0)
-    self_check = (their_k < 0) | is_attacked(b.board, their_k_c, us)
-    checked = (our_k >= 0) & is_attacked(b.board, our_k_c, them)
+    # whole-board attack maps (one per color) instead of per-king
+    # is_attacked queries: elementwise over the ray tensors the move
+    # generator gathers anyway (see attack_map). A missing king's
+    # board==code one-hot is all-False, so the our_k>=0 guard is implicit.
+    att_us = attack_map(b.board, us)
+    att_them = attack_map(b.board, them)
+    our_king_code = jnp.where(us == 0, T.W_KING, T.B_KING)
+    their_king_code = jnp.where(them == 0, T.W_KING, T.B_KING)
+    their_k_attacked = jnp.any(att_us & (b.board == their_king_code))
+    self_check = (their_k < 0) | their_k_attacked
+    checked = jnp.any(att_them & (b.board == our_king_code))
     kind = jnp.int32(TERM_NONE)
 
     if variant == "antichess":
@@ -197,7 +284,7 @@ def node_rules(b: Board, variant: str = "standard"):
         # enemy king first)
         illegal = ~lost & (
             (their_k < 0)
-            | (is_attacked(b.board, their_k_c, us) & ~adj)
+            | (their_k_attacked & ~adj)
         )
         checked = checked & ~adj  # adjacent kings can never be in check
         kind = jnp.where(lost, TERM_LOSS, kind)
