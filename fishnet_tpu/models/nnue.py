@@ -174,9 +174,23 @@ def apply_acc_updates_768(params: NnueParams, acc: jnp.ndarray,
     codes/sqs/signs: (K,) piece changes (code 0 → no-op). Cost: 2K gathers
     of an (L1,) row — this is the whole point of board768.
     """
+    # ft_w[idx] as a one-hot contraction rather than a gather: a K-row
+    # data-dependent gather lowers to a serialized kCustom fusion on TPU
+    # (round-5 device profile), while the one-hot form is an MXU matmul.
+    # Bit-identical: exactly one column of the one-hot is set per row, so
+    # each contracted row is the exact ft_w row (x + 0 is exact in both
+    # f32 and int32), and the K-row delta sum below is unchanged.
+    # (a matmul against the one-hot would hit the MXU's bf16 default
+    # precision and round f32 weights — the masked sum is exact for every
+    # weight dtype: adding zeros never perturbs the single selected row)
+    nf = params.ft_w.shape[0]
     for persp in (0, 1):
         idx = feature_index_768(codes, sqs, jnp.int32(persp))  # (K,)
-        rows = params.ft_w[jnp.clip(idx, 0)]  # (K, L1)
+        oh = idx[:, None] == jnp.arange(nf, dtype=jnp.int32)[None, :]
+        rows = jnp.sum(
+            jnp.where(oh[:, :, None], params.ft_w[None, :, :], 0),
+            axis=1, dtype=params.ft_w.dtype,
+        )  # (K, L1)
         rows = jnp.where((idx >= 0)[:, None], rows, 0)
         delta = jnp.sum(
             rows * signs[:, None].astype(rows.dtype), axis=0,
@@ -264,6 +278,25 @@ def output_bucket(board64: jnp.ndarray) -> jnp.ndarray:
     return jnp.clip((count - 1) // 4, 0, NUM_OUTPUT_BUCKETS - 1)
 
 
+def _bucket_weights(params: NnueParams, bucket: jnp.ndarray):
+    """Layer-stack weights for one output bucket, selected by an 8-way
+    where-chain instead of `w[bucket]` — the data-dependent gather lowers
+    to a serialized per-lane fusion on TPU (round-5 device profile) while
+    the select chain is vectorized; the selected values (and downstream
+    matmul shapes, hence float bits) are identical."""
+    picked = None
+    for n in range(NUM_OUTPUT_BUCKETS):
+        cur = (params.l1_w[n], params.l1_b[n], params.l2_w[n],
+               params.l2_b[n], params.out_w[n], params.out_b[n])
+        if picked is None:
+            picked = cur
+        else:
+            picked = tuple(
+                jnp.where(bucket == n, c, p) for c, p in zip(cur, picked)
+            )
+    return picked
+
+
 def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
                      bucket: jnp.ndarray) -> jnp.ndarray:
     """Centipawn score from the side to move's perspective (scalar f32)."""
@@ -273,24 +306,19 @@ def forward_from_acc(params: NnueParams, acc: jnp.ndarray, stm: jnp.ndarray,
         # fixed-point ladder: activations [0,QA] int8, weights 1/QW
         # steps, int8×int8→int32 dots (the MXU's fastest mode), >>6
         # rescale between layers; exact integer arithmetic throughout
+        w1, b1, w2, b2, ow, ob = _bucket_weights(params, bucket)
         x = jnp.clip(jnp.concatenate([own, opp]), 0, QA).astype(jnp.int8)
-        h = jnp.matmul(
-            x, params.l1_w[bucket], preferred_element_type=jnp.int32
-        ) + params.l1_b[bucket]
+        h = jnp.matmul(x, w1, preferred_element_type=jnp.int32) + b1
         h = jnp.clip(h >> QW_SHIFT, 0, QA).astype(jnp.int8)
-        h = jnp.matmul(
-            h, params.l2_w[bucket], preferred_element_type=jnp.int32
-        ) + params.l2_b[bucket]
+        h = jnp.matmul(h, w2, preferred_element_type=jnp.int32) + b2
         h = jnp.clip(h >> QW_SHIFT, 0, QA).astype(jnp.int8)
-        out = jnp.matmul(
-            h, params.out_w[bucket], preferred_element_type=jnp.int32
-        ) + params.out_b[bucket]
+        out = jnp.matmul(h, ow, preferred_element_type=jnp.int32) + ob
         return out.astype(jnp.float32) * (OUTPUT_SCALE / (QA * QW))
     x = jnp.concatenate([_crelu(own), _crelu(opp)])  # (2*L1,)
-    w1 = params.l1_w[bucket]
-    h = _crelu(x @ w1 + params.l1_b[bucket])
-    h = _crelu(h @ params.l2_w[bucket] + params.l2_b[bucket])
-    out = h @ params.out_w[bucket] + params.out_b[bucket]
+    w1, b1, w2, b2, ow, ob = _bucket_weights(params, bucket)
+    h = _crelu(x @ w1 + b1)
+    h = _crelu(h @ w2 + b2)
+    out = h @ ow + ob
     return out * OUTPUT_SCALE
 
 

@@ -127,13 +127,18 @@ _ORTHO_DIR = np.array([True, True, False, False, True, True, False, False])
 
 
 def attack_map(board64: jnp.ndarray, by_color: jnp.ndarray,
-               skip1=None, skip2=None) -> jnp.ndarray:
+               skip_own1=None, skip_own2=None) -> jnp.ndarray:
     """(64,) bool: every square attacked by `by_color`, in one pass.
 
-    skip1/skip2 (optional square indices) are treated as EMPTY for slider
-    blocking — the castling test lifts the moving king and rook off the
-    board. Skipped squares never count as attackers either (they hold the
-    castler's own pieces, and a skipped square can never be ray-first).
+    skip_own1/skip_own2 (optional square indices) are treated as EMPTY for
+    slider blocking — the castling test lifts the moving king and rook off
+    the board. PRECONDITION: skipped squares must hold pieces of
+    `by_color`'s OPPONENT (the castler's own king/rook). The skip is only
+    applied to slider occupancy; the king/knight/pawn attacker terms still
+    read the unskipped board, so a skipped square holding one of
+    `by_color`'s own king/knight/pawn attackers would produce a phantom
+    attack that lifted-board semantics would not. The castling caller
+    satisfies this by construction; any new caller must too.
 
     Replaces per-square `is_attacked` queries in the search step: the
     round-4 device profile showed the castling path's 14 vmapped
@@ -146,10 +151,10 @@ def attack_map(board64: jnp.ndarray, by_color: jnp.ndarray,
     rvalid = rsq_t >= 0
     rpiece = board64[jnp.clip(rsq_t, 0)]  # same gather as movegen → CSE
     rocc = (rpiece > 0) & rvalid
-    if skip1 is not None:
-        rocc &= rsq_t != skip1
-    if skip2 is not None:
-        rocc &= rsq_t != skip2
+    if skip_own1 is not None:
+        rocc &= rsq_t != skip_own1
+    if skip_own2 is not None:
+        rocc &= rsq_t != skip_own2
     before = exclusive_cumsum_small(rocc.astype(jnp.int32), axis=2)
     is_first = rocc & (before == 0)
     # enemy slider sliding along this (symmetric) direction — elementwise
@@ -169,13 +174,15 @@ def attack_map(board64: jnp.ndarray, by_color: jnp.ndarray,
     knight_hit = jnp.any(ktp == knight_code, axis=1)
 
     # pawns of by_color attacking sq sit on the squares a pawn of the
-    # *opposite* color on sq would attack
-    ps = jnp.where(
-        by_color == 0,
-        jnp.asarray(T.PAWN_CAPTURES[1]),
-        jnp.asarray(T.PAWN_CAPTURES[0]),
-    )  # (64, 2)
-    psp = jnp.where(ps >= 0, board64[jnp.clip(ps, 0)], 0)
+    # *opposite* color on sq would attack. Gather through each CONSTANT
+    # per-color table and select by color — board64[dynamic_idx] lowers to
+    # a serialized per-element gather on TPU (round-5 device profile).
+    ps0 = np.asarray(T.PAWN_CAPTURES[1])  # (64, 2) static
+    ps1 = np.asarray(T.PAWN_CAPTURES[0])
+    ps = jnp.where(by_color == 0, jnp.asarray(ps0), jnp.asarray(ps1))
+    psp_w = board64[np.clip(ps0, 0, 63)]
+    psp_b = board64[np.clip(ps1, 0, 63)]
+    psp = jnp.where(ps >= 0, jnp.where(by_color == 0, psp_w, psp_b), 0)
     pawn_code = jnp.where(by_color == 0, T.W_PAWN, T.B_PAWN)
     pawn_hit = jnp.any(psp == pawn_code, axis=1)
 

@@ -3,19 +3,24 @@
 Strategy (TPU-first, no data-dependent shapes): enumerate a fixed candidate
 space — (64 sq × 8 dirs × 7 steps) slider slots, (64×8) knight and king
 slots, (64×4) pawn slots, (64×3×4) promotion slots, 2 castling slots — as
-masks, then compact valid candidates into a fixed (MAX_MOVES,) move list
-with a cumsum scatter. Legality is *not* fully resolved here: the search
-uses king-capture pruning (an illegal mover is refuted one ply later when
-its king is captured), so only castling does attack checks. This keeps the
-kernel free of pin/evasion logic; the host library remains the legality
-oracle for tests.
+masks, then compact valid candidates into a fixed (MAX_MOVES,) ORDERED move
+list with one single-array sort of packed (ordering_key << 16 | move)
+values (see generate_moves for the packing invariants). Legality is *not*
+fully resolved here: the search uses king-capture pruning (an illegal mover
+is refuted one ply later when its king is captured), so only castling does
+attack checks. This keeps the kernel free of pin/evasion logic; the host
+library remains the legality oracle for tests.
 
 Single-lane function; `vmap` over lanes gives the batch.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+
+import numpy as np
 
 from . import tables as T
 from .board import (
@@ -28,13 +33,26 @@ from .board import (
     piece_type,
 )
 
+# static per-color pawn-target tables. Indexing `board[dynamic_idx]` with a
+# data-dependent index array lowers to a serialized kCustom gather on TPU
+# (the round-5 device profile measured ~0.5 us per gathered element — five
+# such gathers cost ~370 us of the 1.6 ms step). Indexing with a CONSTANT
+# table compiles to vectorized code, so every pawn target is gathered per
+# color through a constant table and the two results are selected by stm.
+_SQ = np.arange(64, dtype=np.int32)
+_TO1 = np.stack([np.clip(_SQ + 8, 0, 63), np.clip(_SQ - 8, 0, 63)])  # (2,64)
+_TO2 = np.stack([np.clip(_SQ + 16, 0, 63), np.clip(_SQ - 16, 0, 63)])
+_CAPS = np.asarray(T.PAWN_CAPTURES)  # (2, 64, 2), -1 padded
+_CSQ = np.clip(_CAPS, 0, 63)
+
 MAX_MOVES = T.MAX_MOVES
 # crazyhouse adds up to 5 droppable types × ≤64 empty squares on top of
 # ordinary board moves; its program compiles with a wider move list.
 # 5*64 + MAX_MOVES is a PROVEN bound (drops can never exceed 5 types ×
-# empty squares; board moves are bounded by MAX_MOVES): _compact silently
-# drops overflow, so an unproven cap would be a correctness hole — extra
-# width only costs padding in the crazyhouse program
+# empty squares; board moves are bounded by MAX_MOVES): the compaction
+# silently drops overflow beyond the cap, so an unproven cap would be a
+# correctness hole — extra width only costs padding in the crazyhouse
+# program
 MAX_MOVES_ZH = 5 * 64 + MAX_MOVES
 DROP_FLAG = 1 << 15  # move encoding: drops are DROP_FLAG | pt<<12 | to<<6 | to
 
@@ -43,44 +61,42 @@ def max_moves_for(variant: str) -> int:
     return MAX_MOVES_ZH if variant == "crazyhouse" else MAX_MOVES
 
 
-def _compact(cands: jnp.ndarray, valid: jnp.ndarray, keys: jnp.ndarray,
-             cap: int = MAX_MOVES):
-    """Compact valid candidate moves into a dense (cap,) list.
+@functools.lru_cache(maxsize=None)
+def _hist_idx_tables(variant: str):
+    """Per-color (n_candidates,) tables of `cand & 4095` (the from|to
+    history index) for every candidate slot, as numpy constants.
 
-    keys: smaller = earlier after the final sort (move ordering).
-    Returns (moves, keys, count); overflow beyond cap is dropped.
-
-    TPU note: implemented as ONE stable sort by validity-masked candidate
-    position, not a cumsum + scatter. The round-4 on-device profile showed
-    XLA:TPU lowers the (B, ~5.6k) → (B, cap) batched scatter to a
-    serialized custom fusion costing 2.1 ms/step PER SCATTER (two of them
-    = 60% of the whole search step); the sort form is vectorized and
-    bit-identical: valid candidates keep candidate order (their sort key
-    is their unique position), invalid ones share key N and a uniform
-    (-1, INT32_MAX) payload, and overflow truncation drops exactly the
-    candidates the scatter's mode="drop" dropped (positions >= cap).
-    """
-    cands = cands.reshape(-1)
-    valid = valid.reshape(-1)
-    keys = keys.reshape(-1)
-    n = cands.shape[0]
-    pos = jnp.arange(n, dtype=jnp.int32)
-    sortk = jnp.where(valid, pos, jnp.int32(n))
-    _, moves, out_keys = jax.lax.sort(
-        (sortk,
-         jnp.where(valid, cands, -1),
-         jnp.where(valid, keys, jnp.iinfo(jnp.int32).max)),
-        dimension=0, is_stable=False, num_keys=1,
-    )
-    if n < cap:  # static: candidate space narrower than the move list
-        moves = jnp.concatenate([moves, jnp.full((cap - n,), -1, jnp.int32)])
-        out_keys = jnp.concatenate(
-            [out_keys, jnp.full((cap - n,), jnp.iinfo(jnp.int32).max, jnp.int32)]
+    Candidate VALUES are static per side to move — every section below
+    mirrors `generate_moves`' candidate assembly (same tables, same
+    order) — except the two castling slots, which hold 0 here; castling
+    keys are 900, and the history bonus only applies at keys 1000/1100,
+    so those slots never read their (meaningless) history value. Constant
+    index tables let the per-step history lookup compile to a vectorized
+    static gather instead of the serialized dynamic-gather fusion the
+    round-5 device profile flagged (tests/test_device_board.py
+    test_hist_index_tables_match_candidates pins the mirror)."""
+    rsq = np.clip(np.asarray(T.RAYS), 0, None)
+    sl = (_SQ[:, None, None] | (rsq << 6)).reshape(-1)
+    kn = (_SQ[:, None] | (np.clip(np.asarray(T.KNIGHT_TARGETS), 0, None) << 6)).reshape(-1)
+    kg = (_SQ[:, None] | (np.clip(np.asarray(T.KING_TARGETS), 0, None) << 6)).reshape(-1)
+    n_promo = 5 if variant == "antichess" else 4
+    out = []
+    for c in (0, 1):
+        pawn_tos = np.stack(
+            [_TO1[c], _TO2[c], _CSQ[c][:, 0], _CSQ[c][:, 1]], axis=1
         )
-    moves = moves[:cap]
-    out_keys = out_keys[:cap]
-    count = jnp.minimum(jnp.sum(valid), cap)
-    return moves, out_keys, count
+        pw = (_SQ[:, None] | (pawn_tos << 6)).reshape(-1)
+        promo_tos = np.stack([_TO1[c], _CSQ[c][:, 0], _CSQ[c][:, 1]], axis=1)
+        pr = np.broadcast_to(
+            (_SQ[:, None] | (promo_tos << 6))[:, :, None], (64, 3, n_promo)
+        ).reshape(-1)
+        secs = [sl, kn, kg, pw, pr, np.zeros(2, np.int32)]
+        if variant == "crazyhouse":
+            secs.append(
+                np.broadcast_to(((_SQ << 6) | _SQ)[None, :], (5, 64)).reshape(-1)
+            )
+        out.append((np.concatenate(secs) & 4095).astype(np.int32))
+    return out[0], out[1]
 
 
 def _capture_key(victim_type: jnp.ndarray, attacker_type: jnp.ndarray,
@@ -109,6 +125,61 @@ def generate_moves(b: Board, variant: str = "standard",
     counters). They reorder only the quiet tail (keys >= 900), so the
     noisy prefix the quiescence search expands is unaffected.
     """
+    white, flat_moves, flat_valid, flat_keys = _candidate_space(b, variant)
+
+    # quiet-move ordering refinements on the FULL candidate space:
+    # history first (quiets 1000 → 911..1010, drops 1100 → 1011..1110 by
+    # counter magnitude), then killers jump the whole quiet tail to 901
+    if hist is not None:
+        # candidate from|to indices are static per color (castling slots
+        # excepted — their key is 900, never history-adjusted), so the
+        # lookup is a constant-index gather per color + a stm select
+        hw, hb = _hist_idx_tables(variant)
+        hval = jnp.where(white, hist[hw], hist[hb])
+        hbonus = jnp.clip(hval >> 5, 0, 99)
+        flat_keys = jnp.where(flat_keys == 1000, 1010 - hbonus, flat_keys)
+        flat_keys = jnp.where(flat_keys == 1100, 1110 - hbonus, flat_keys)
+    if killers is not None:
+        # candidates are never -1, so an empty killer slot (-1) matches
+        # nothing; invalid candidates are masked out at the pack below
+        is_k = (flat_moves == killers[0]) | (flat_moves == killers[1])
+        flat_keys = jnp.where(is_k & (flat_keys >= 900), 901, flat_keys)
+
+    # compaction + ordering in ONE single-array sort: pack (key << 16) |
+    # move — key < 2048 and move <= 0xFFFF, so valid packs stay positive
+    # and below the invalid sentinel — sort ascending, keep the first cap
+    # entries. Replaces round 4's 3-array compaction sort + stable
+    # ordering sort (the round-5 device profile: 350 us + the argsort
+    # gather). Ties within a key break by move encoding (the previous
+    # two-stage form broke them by candidate position): any deterministic
+    # order is a valid move ordering, and the host oracle calls this same
+    # function, so device/oracle equality is unaffected.
+    cap = max_moves_for(variant)
+    packed = jnp.where(
+        flat_valid, (flat_keys << 16) | flat_moves,
+        jnp.int32(jnp.iinfo(jnp.int32).max),
+    )
+    packed = jax.lax.sort(packed, dimension=0, is_stable=False)
+    top = jax.lax.slice_in_dim(packed, 0, cap)
+    moves = jnp.where(
+        top != jnp.iinfo(jnp.int32).max, top & 0xFFFF, jnp.int32(-1)
+    )
+    count = jnp.minimum(jnp.sum(flat_valid), cap).astype(jnp.int32)
+    # captures 100..739, queen promos down to 10; castling 900, quiets 1000
+    noisy = jnp.minimum(
+        jnp.sum(flat_valid & (flat_keys < 900)), cap
+    ).astype(jnp.int32)
+    return moves, count, noisy
+
+
+def _candidate_space(b: Board, variant: str = "standard"):
+    """The fixed candidate space for one lane: → (white (), flat_moves,
+    flat_valid, flat_keys — each (n_candidates,)).
+
+    Section order (mirrored by _hist_idx_tables; pinned by
+    tests/test_device_board.py test_hist_index_tables_match_candidates):
+    sliders (64,8,7), knights (64,8), king (64,8), pawns (64,4), promos
+    (64,3,n_promo), castling (2,), then crazyhouse drops (5,64)."""
     board = b.board
     us = b.stm
     them = 1 - us
@@ -178,26 +249,29 @@ def generate_moves(b: Board, variant: str = "standard",
         all_iscap.append(piece_color(tpiece) == them)
 
     # ------------------------------------------------------------------ pawns
-    fwd = jnp.where(us == 0, 8, -8)
+    white = us == 0
     our_pawn = own & (types == 0)
     ranks = sq_idx >> 3
-    last_rank = jnp.where(us == 0, 7, 0)
-    start_rank = jnp.where(us == 0, 1, 6)
-    pre_promo = ranks == jnp.where(us == 0, 6, 1)
+    start_rank = jnp.where(white, 1, 6)
+    pre_promo = ranks == jnp.where(white, 6, 1)
 
-    to1 = jnp.clip(sq_idx + fwd, 0, 63)
-    to1_ok = our_pawn & (board[to1] == 0)
-    to2 = jnp.clip(sq_idx + 2 * fwd, 0, 63)
+    # every target square/piece via constant-table gathers selected by stm
+    # (see _TO1/_CAPS above for why not board[dynamic_idx])
+    to1 = jnp.where(white, jnp.asarray(_TO1[0]), jnp.asarray(_TO1[1]))
+    b_to1 = jnp.where(white, board[_TO1[0]], board[_TO1[1]])
+    to1_ok = our_pawn & (b_to1 == 0)
+    to2 = jnp.where(white, jnp.asarray(_TO2[0]), jnp.asarray(_TO2[1]))
+    b_to2 = jnp.where(white, board[_TO2[0]], board[_TO2[1]])
     dbl_rank = ranks == start_rank
     if variant == "horde":
         # horde pawns on the back rank may also double-push
-        dbl_rank |= (us == 0) & (ranks == 0)
-    to2_ok = to1_ok & dbl_rank & (board[to2] == 0)
+        dbl_rank |= white & (ranks == 0)
+    to2_ok = to1_ok & dbl_rank & (b_to2 == 0)
 
-    caps = jnp.asarray(T.PAWN_CAPTURES)[us]  # (64, 2)
+    caps = jnp.where(white, jnp.asarray(_CAPS[0]), jnp.asarray(_CAPS[1]))
     cvalid = caps >= 0
-    csq = jnp.clip(caps, 0)
-    cpiece = board[csq]
+    csq = jnp.where(white, jnp.asarray(_CSQ[0]), jnp.asarray(_CSQ[1]))
+    cpiece = jnp.where(white, board[_CSQ[0]], board[_CSQ[1]])
     cap_ok = (
         our_pawn[:, None]
         & cvalid
@@ -206,12 +280,15 @@ def generate_moves(b: Board, variant: str = "standard",
 
     # non-promotion pawn moves: [push1, push2, capL, capR]
     pawn_tos = jnp.stack([to1, to2, csq[:, 0], csq[:, 1]], axis=1)  # (64,4)
+    b_pawn_tos = jnp.stack(
+        [b_to1, b_to2, cpiece[:, 0], cpiece[:, 1]], axis=1
+    )  # board[pawn_tos] assembled from the constant-table gathers
     pawn_ok = jnp.stack(
         [to1_ok & ~pre_promo, to2_ok, cap_ok[:, 0] & ~pre_promo[:],
          cap_ok[:, 1] & ~pre_promo[:]], axis=1,
     )
     cands = sq_idx[:, None] | (pawn_tos << 6)
-    vict = jnp.maximum(piece_type(board[pawn_tos]), 0)
+    vict = jnp.maximum(piece_type(b_pawn_tos), 0)
     is_cap = jnp.stack(
         [jnp.zeros(64, bool), jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]],
         axis=1,
@@ -225,6 +302,7 @@ def generate_moves(b: Board, variant: str = "standard",
     # promotions: [push, capL, capR] × 4 promo pieces (5 in antichess,
     # which allows promotion to king)
     promo_tos = jnp.stack([to1, csq[:, 0], csq[:, 1]], axis=1)  # (64, 3)
+    b_promo_tos = jnp.stack([b_to1, cpiece[:, 0], cpiece[:, 1]], axis=1)
     promo_ok_base = jnp.stack(
         [to1_ok & pre_promo, cap_ok[:, 0] & pre_promo, cap_ok[:, 1] & pre_promo],
         axis=1,
@@ -239,7 +317,7 @@ def generate_moves(b: Board, variant: str = "standard",
         | (promos[None, None, :] << 12)
     )
     valid = promo_ok_base[:, :, None] & jnp.ones((1, 1, len(promo_list)), bool)
-    vict = jnp.maximum(piece_type(board[promo_tos]), 0)[:, :, None]
+    vict = jnp.maximum(piece_type(b_promo_tos), 0)[:, :, None]
     is_cap = jnp.stack([jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]], axis=1)
     keys = _capture_key(
         jnp.broadcast_to(vict, cands.shape),
@@ -282,7 +360,7 @@ def generate_moves(b: Board, variant: str = "standard",
         # those two squares skipped for slider blocking (bit-identical to
         # the old per-square is_attacked on the lifted board; see
         # board.attack_map's profile note for why)
-        att = attack_map(board, them, skip1=ksq_c, skip2=rsq_c)
+        att = attack_map(board, them, skip_own1=ksq_c, skip_own2=rsq_c)
         kpath = (sq_idx >= lo_k) & (sq_idx <= hi_k)
         safe = ~jnp.any(att & kpath)
         return has & empty_ok & safe, sq_idx[0] * 0 + (ksq_c | (rsq_c << 6))
@@ -324,26 +402,7 @@ def generate_moves(b: Board, variant: str = "standard",
         flat_iscap = jnp.concatenate([c.reshape(-1) for c in all_iscap])
         any_cap = jnp.any(flat_valid & flat_iscap)
         flat_valid &= jnp.where(any_cap, flat_iscap, True)
-    moves, keys, count = _compact(
-        flat_moves, flat_valid, flat_keys, cap=max_moves_for(variant)
-    )
-
-    # quiet-move ordering refinements, applied before the sort:
-    # history first (quiets 1000 → 911..1010, drops 1100 → 1011..1110 by
-    # counter magnitude), then killers jump the whole quiet tail to 901
-    if hist is not None:
-        hbonus = jnp.clip(hist[jnp.clip(moves, 0) & 4095] >> 5, 0, 99)
-        keys = jnp.where(keys == 1000, 1010 - hbonus, keys)
-        keys = jnp.where(keys == 1100, 1110 - hbonus, keys)
-    if killers is not None:
-        is_k = ((moves == killers[0]) | (moves == killers[1])) & (moves >= 0)
-        keys = jnp.where(is_k & (keys >= 900), 901, keys)
-
-    # order: stable sort by key so captures/promotions are searched first
-    order = jnp.argsort(keys, stable=True)
-    # captures 100..739, queen promos down to 10; castling 900, quiets 1000
-    noisy = jnp.sum(keys < 900).astype(jnp.int32)
-    return moves[order], count, noisy
+    return white, flat_moves, flat_valid, flat_keys
 
 
 v_generate_moves = jax.vmap(generate_moves, in_axes=(Board(0, 0, 0, 0, 0, 0),))

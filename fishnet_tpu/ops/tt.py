@@ -98,27 +98,48 @@ def hash_board(board64, stm, ep, castling, extra=None, variant: str = "standard"
     `variant` (STATIC) folds Board.extra in: crazyhouse pockets + promoted
     bits, threeCheck counters — standard hashes are unchanged."""
     sq = jnp.arange(64, dtype=jnp.int32)
-    idx = board64 * 64 + sq  # code 0 → slots 0..63, masked below
     mask = board64 > 0
 
+    # TPU formulation note (round-5 device profile): `z[board64 * 64 + sq]`
+    # is a data-dependent gather that lowers to a serialized kCustom fusion
+    # (~29 us/step per table inside the search step). Every dynamic lookup
+    # below is therefore a one-hot select against a STATIC slice of z —
+    # exactly one branch matches, so the folded values (and the hashes)
+    # are bit-identical to the gather form.
+    def onehot_pick(zslice, val):
+        """XOR term z[off + val] as a one-hot select; zslice (K,) static,
+        val (...,) in [0, K)."""
+        k = zslice.shape[0]
+        oh = val[..., None] == jnp.arange(k, dtype=jnp.int32)
+        return jnp.sum(jnp.where(oh, zslice, jnp.uint32(0)), axis=-1)
+
     def fold(z):
-        rows = jnp.where(mask, z[idx], 0)
+        zps = z[: 13 * 64].reshape(13, 64)  # static slice: [code, sq]
+        sel = jnp.zeros_like(board64).astype(jnp.uint32)
+        for code in range(1, 13):
+            sel = jnp.where(board64 == code, zps[code], sel)
+        rows = jnp.where(mask, sel, 0)
         h = jax.lax.reduce(
             rows, jnp.uint32(0), jax.lax.bitwise_xor, (rows.ndim - 1,)
         )
-        h ^= z[_EP_OFF + ep + 1]
+        h ^= onehot_pick(z[_EP_OFF:_EP_OFF + 65], ep + 1)
         for i in range(4):
-            h ^= z[_CASTLE_OFF + i * 65 + castling[..., i] + 1]
-        h ^= z[_STM_OFF + stm]
+            off = _CASTLE_OFF + i * 65
+            h ^= onehot_pick(z[off:off + 65], castling[..., i] + 1)
+        h ^= jnp.where(stm == 0, z[_STM_OFF], z[_STM_OFF + 1])
         vid = _VARIANT_ID.get(variant, 0)
         if vid:
             h ^= z[_VARIANT_OFF + vid]
         if variant == "threeCheck":
             for c in (0, 1):
-                h ^= z[_CHECKS_OFF + c * 4 + jnp.clip(extra[..., c], 0, 3)]
+                off = _CHECKS_OFF + c * 4
+                h ^= onehot_pick(z[off:off + 4], jnp.clip(extra[..., c], 0, 3))
         elif variant == "crazyhouse":
             for slot in range(10):
-                h ^= z[_POCKET_OFF + slot * 17 + jnp.clip(extra[..., slot], 0, 16)]
+                off = _POCKET_OFF + slot * 17
+                h ^= onehot_pick(
+                    z[off:off + 17], jnp.clip(extra[..., slot], 0, 16)
+                )
             words = extra[..., 10:12]
             bits = (
                 jnp.right_shift(words[..., sq // 32], sq % 32) & 1

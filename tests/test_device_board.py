@@ -115,3 +115,101 @@ def test_castling_move_application(kernels):
     child_q = pos.push_uci("e1a1")
     dev_q = mk(from_position(pos), encode_host_move(pos.parse_uci("e1a1")))
     assert boards_equal(dev_q, from_position(child_q))
+
+
+def test_history_ordering_uses_correct_slot_both_colors():
+    """Pins the _hist_idx_tables mirror (ops/movegen.py): a history bump
+    on one specific quiet move's from|to slot must pull exactly THAT move
+    to the front of the quiet tail, for white and for black. A misaligned
+    static index table would credit a different candidate slot."""
+    import jax.numpy as jnp
+
+    cases = [
+        # (fen, uci of a late quiet move expected to jump the quiet tail)
+        ("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1", "h2h3"),
+        ("rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR b KQkq - 0 1", "h7h6"),
+    ]
+    gen = jax.jit(
+        lambda b, h: generate_moves(b, killers=jnp.asarray([-1, -1]), hist=h)
+    )
+    for fen, uci in cases:
+        pos = Position.from_fen(fen)
+        mv = encode_host_move(pos.parse_uci(uci))
+        hist = np.zeros(4096, np.int32)
+        base_moves, count, noisy = gen(from_position(pos), jnp.asarray(hist))
+        hist[mv & 4095] = 1 << 16
+        moves, count, noisy = gen(from_position(pos), jnp.asarray(hist))
+        moves = np.asarray(moves)[: int(count)].tolist()
+        quiet_tail = moves[int(noisy):]
+        # castling (key 900) sorts before history-bumped quiets (911+),
+        # so the bumped move must lead the quiet tail modulo castling
+        assert mv in quiet_tail
+        assert quiet_tail.index(mv) <= 1, (uci, quiet_tail[:4])
+        # and without the bump the move must NOT already be first
+        base_tail = np.asarray(base_moves)[: int(count)].tolist()[int(noisy):]
+        assert base_tail.index(mv) > 1
+
+
+def test_hist_index_tables_match_candidates():
+    """Exhaustive pin of the _hist_idx_tables mirror: for every variant
+    table shape and both colors, the static from|to index table must
+    equal `cand & 4095` for EVERY candidate slot the traced assembly
+    produces (castling slots excepted — they hold 0 in the table and are
+    never history-adjusted because their ordering key is 900)."""
+    from fishnet_tpu.chess.variants import from_fen as v_from_fen
+    from fishnet_tpu.ops.movegen import _candidate_space, _hist_idx_tables
+
+    fens = {
+        0: "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        1: "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR b KQkq - 0 1",
+    }
+    # the three distinct table shapes: standard (4 promos), antichess
+    # (5 promos incl. king), crazyhouse (+ drop section)
+    for variant in ("standard", "antichess", "crazyhouse"):
+        tables = _hist_idx_tables(variant)
+        space = jax.jit(lambda b: _candidate_space(b, variant))
+        for color in (0, 1):
+            pos = (
+                Position.from_fen(fens[color]) if variant == "standard"
+                else v_from_fen(fens[color], variant)
+            )
+            _, flat_moves, _, _ = space(from_position(pos))
+            cands = np.asarray(flat_moves) & 4095
+            table = np.asarray(tables[color])
+            assert cands.shape == table.shape, variant
+            # locate the 2 castling slots: fixed offset before the drops
+            n = cands.shape[0]
+            drops = 5 * 64 if variant == "crazyhouse" else 0
+            castle_lo = n - drops - 2
+            mism = np.nonzero(cands != table)[0]
+            allowed = {castle_lo, castle_lo + 1}
+            assert set(mism.tolist()) <= allowed, (
+                variant, color, mism[:10], cands[mism[:10]], table[mism[:10]]
+            )
+
+
+def test_history_ordering_crazyhouse_drop_slot():
+    """Same mirror pin for the drop section of the crazyhouse tables."""
+    import jax.numpy as jnp
+
+    from fishnet_tpu.chess.variants import from_fen as v_from_fen
+    from fishnet_tpu.ops.movegen import DROP_FLAG
+
+    fen = "rnb1kbnr/ppp1pppp/8/3p4/3P4/8/PPPqPPPP/RNBQKBNR[Nn] w KQkq - 0 4"
+    pos = v_from_fen(fen, "crazyhouse")
+    gen = jax.jit(
+        lambda b, h: generate_moves(
+            b, "crazyhouse", killers=jnp.asarray([-1, -1]), hist=h
+        )
+    )
+    to_sq = 16  # a3: drop N@a3
+    drop_mv = DROP_FLAG | (1 << 12) | (to_sq << 6) | to_sq
+    hist = np.zeros(4096, np.int32)
+    hist[((to_sq << 6) | to_sq) & 4095] = 1 << 16
+    moves, count, noisy = gen(from_position(pos), jnp.asarray(hist))
+    moves = np.asarray(moves)[: int(count)].tolist()
+    assert drop_mv in moves
+    # drops normally order at 1100 (after board quiets); the bumped drop
+    # lands at 1011..1110 - 99 → ahead of every un-bumped drop
+    drops = [m for m in moves if m & DROP_FLAG]
+    assert drops[0] == drop_mv

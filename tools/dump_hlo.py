@@ -1,0 +1,95 @@
+"""Dump the optimized HLO of run_segment and summarize named fusions.
+
+Companion to profile_step.py: the profiler trace names ops `fusion.N` /
+`sort.N`; this prints each requested computation's root + operand shapes so
+trace lines map back to source-level work.
+
+Usage: python tools/dump_hlo.py [B] [depth] [max_ply] fusion.803 sort.59 ...
+       python tools/dump_hlo.py [B] [depth] [max_ply] --full > /tmp/hlo.txt
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if not a.startswith("--") and "." not in a]
+    names = [a for a in sys.argv[1:] if "." in a]
+    B = int(args[0]) if len(args) > 0 else 64
+    depth = int(args[1]) if len(args) > 1 else 3
+    max_ply = int(args[2]) if len(args) > 2 else depth + 1
+
+    import jax
+    import jax.numpy as jnp
+
+    from fishnet_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    from fishnet_tpu.models import nnue
+    from fishnet_tpu.ops import search as S
+    from bench import _roots_for
+
+    roots = _roots_for(B, "standard", "standard")
+    params = nnue.init_params(jax.random.PRNGKey(0), l1=64, feature_set="board768")
+    depth_arr = jnp.full((B,), depth, jnp.int32)
+    budget_arr = jnp.full((B,), 10_000_000, jnp.int32)
+    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply,
+                              "standard")
+    compiled = S._run_segment_jit.lower(
+        params, state, None, 200, "standard", False).compile()
+    txt = compiled.as_text()
+    if "--full" in sys.argv:
+        print(txt)
+        return
+
+    # index computations by name
+    comps: dict[str, str] = {}
+    cur = None
+    buf: list[str] = []
+    for line in txt.splitlines():
+        m = re.match(r"^(%?[\w\.\-]+)\s.*{\s*(//.*)?$", line)
+        if line.startswith("ENTRY") or (m and not line.startswith(" ")):
+            if cur:
+                comps[cur] = "\n".join(buf)
+            cur = (m.group(1).lstrip("%") if m else "ENTRY")
+            buf = [line]
+        else:
+            buf.append(line)
+    if cur:
+        comps[cur] = "\n".join(buf)
+
+    # fusion instruction lines live inside other computations; find them
+    fusion_defs: dict[str, str] = {}
+    for line in txt.splitlines():
+        m = re.search(r"%?([\w\.\-]+)\s*=\s*\S+\s+fusion\(", line)
+        if m:
+            fusion_defs[m.group(1)] = line.strip()
+        m = re.search(r"%?([\w\.\-]+)\s*=\s*\S+\s+sort\(", line)
+        if m:
+            fusion_defs[m.group(1)] = line.strip()
+
+    for name in names:
+        print(f"===== {name} =====")
+        d = fusion_defs.get(name)
+        if d:
+            print(d[:2000])
+            # print the called computation too
+            m = re.search(r"calls=%?([\w\.\-]+)", d)
+            if m and m.group(1) in comps:
+                body = comps[m.group(1)]
+                lines = body.splitlines()
+                print(f"  --- computation {m.group(1)} "
+                      f"({len(lines)} lines) ---")
+                for ln in lines[:80]:
+                    print("  " + ln[:160])
+        else:
+            print("  (not found as fusion/sort instruction)")
+        print()
+
+
+if __name__ == "__main__":
+    main()
