@@ -111,7 +111,8 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
 
     On success prints exactly one stdout line: RESULT {json}."""
     t0 = time.time()
-    mode = "select" if os.environ.get("FISHNET_TPU_SELECT_UPDATES") else "scatter"
+    mode = ("scatter" if os.environ.get("FISHNET_TPU_SELECT_UPDATES") == "0"
+            else "select")
     _hb(t0, f"stage B={B} depth={depth} variant={variant} set={fen_set} "
             f"row_mode={mode}: importing jax")
     import jax
@@ -174,7 +175,7 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # from an execution hang in the heartbeat tail
     _hb(t0, "compile_start init_state")
     state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, variant)
-    jax.block_until_ready(state.board)
+    jax.block_until_ready(state.bt)
     _hb(t0, "compile_done init_state (and executed)")
     seg = 20_000
     _hb(t0, f"compile_start run_segment(seg={seg})")
@@ -217,9 +218,9 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                 "variant": variant,
                 "fen_set": fen_set,
                 "row_mode": (
-                    "select"
-                    if os.environ.get("FISHNET_TPU_SELECT_UPDATES")
-                    else "scatter"
+                    "scatter"
+                    if os.environ.get("FISHNET_TPU_SELECT_UPDATES") == "0"
+                    else "select"
                 ),
                 "max_ply": max_ply,
                 "net": os.environ.get("BENCH_NET", "random"),
@@ -245,10 +246,9 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
     env = dict(os.environ)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
-    if select:
-        env["FISHNET_TPU_SELECT_UPDATES"] = "1"
-    else:
-        env.pop("FISHNET_TPU_SELECT_UPDATES", None)
+    # "0" opts into the legacy scatter mode (select is the in-code
+    # default since round 5 — see ops/search.py _SELECT_UPDATES)
+    env["FISHNET_TPU_SELECT_UPDATES"] = "1" if select else "0"
     if extra_env:
         env.update({k: str(v) for k, v in extra_env.items()})
     # child stderr goes to a file, not a pipe: on timeout-kill a pipe's

@@ -18,6 +18,21 @@ jitted `lax.while_loop` step over B independent lanes:
 - per-lane node budgets and depth limits; lanes park in DONE and are
   masked out (divergence tax: a step costs the same while any lane runs).
 
+State layout (round-5 redesign): the round-5 device profile
+(docs/profile-r5.md) showed the step's cost dominated by per-op overhead —
+~380 compiled ops and a ~330 us/step fixed scheduling gap — rather than
+compute. The ~30 small per-node arrays are therefore PACKED into three
+tables so each phase issues ONE fused row write instead of ~a dozen:
+
+  bt   (B, P+1, BT_W)  board rows: board(64), stm, ep, castling(4),
+                       halfmove, extra(12), path-hash words (int32 bits)
+  nt   (B, P+1, NT_W)  per-node search scalars: move cursor, window,
+                       null/LMR state, pv length, remaining depth,
+                       in-check flag, killer slots
+  lane (B, LN_W)       per-lane scalars: ply, mode, return value/depth,
+                       leaf-store mark, node counter, budget, root
+                       window/result, LMR re-search flag
+
 MultiPV and iterative deepening are driven from the host (engine/tpu.py):
 lanes are cheap, so multipv lanes are just more lanes.
 """
@@ -62,14 +77,47 @@ MODE_DONE = 3
 MAX_HIST = 16
 HIST_HM_SENTINEL = -32000
 
-# FISHNET_TPU_SELECT_UPDATES=1: implement every per-lane dynamic row
-# write as a one-hot masked select instead of a dynamic-update-slice
-# scatter. This is the candidate workaround for the device fault
-# bisected in docs/tpu-hang.md (B>=16 lanes with max_ply>=4 hangs or
-# kills the TPU worker — suspected miscompiled scatter at multi-sublane
-# lane counts), and masked selects are often faster on TPU anyway. The
-# two modes are bit-identical (tests/test_search.py proves it on CPU).
-_SELECT_UPDATES = bool(os.environ.get("FISHNET_TPU_SELECT_UPDATES"))
+# ---------------------------------------------------------- packed layouts
+# nt fields (one int32 row per node)
+(NT_COUNT, NT_MIDX, NT_SEARCHED, NT_ALPHA, NT_ALPHA0, NT_BETA, NT_BEST,
+ NT_BMOVE, NT_NULL, NT_LASTRED, NT_PVLEN, NT_DL, NT_INCHECK, NT_K0,
+ NT_K1) = range(15)
+NT_W = 16
+# bt fields (one int32 row per node's board)
+BT_BOARD = 0
+BT_STM = 64
+BT_EP = 65
+BT_CAST = 66
+BT_HM = 70
+BT_EXTRA = 71
+BT_PH1 = 83  # path-hash words, uint32 stored as int32 bits
+BT_PH2 = 84
+BT_W = 96
+# lane fields
+(LN_PLY, LN_MODE, LN_RET, LN_RETD, LN_SMARK, LN_SVAL, LN_NODES, LN_DLIM,
+ LN_BUDGET, LN_RSCORE, LN_RMOVE, LN_RALPHA, LN_RBETA, LN_RESEARCH) = range(14)
+LN_W = 16
+
+# nt fields ENTER initializes on node expansion vs on every entry: a
+# single full-row write reproduces the per-field masks because the row
+# vector keeps the old value wherever the mask is off (see _step_lane)
+_FM_EXPAND = np.zeros(NT_W, bool)
+_FM_EXPAND[[NT_COUNT, NT_MIDX, NT_SEARCHED, NT_ALPHA, NT_ALPHA0, NT_BETA,
+            NT_BEST, NT_BMOVE, NT_NULL, NT_LASTRED]] = True
+_FM_ENTER = np.zeros(NT_W, bool)
+_FM_ENTER[[NT_PVLEN, NT_INCHECK]] = True
+
+# FISHNET_TPU_SELECT_UPDATES: implement every per-lane dynamic row write
+# as a one-hot masked select (=1, the DEFAULT since round 5) instead of a
+# dynamic-update-slice scatter (=0). Select is the workaround for the
+# device fault bisected in docs/tpu-hang.md (B>=16 lanes with max_ply>=4
+# hung or killed the TPU worker — suspected miscompiled scatter at
+# multi-sublane lane counts) AND, since the round-5 packed-table layout,
+# dramatically faster: scatter lowers the packed row writes to a
+# serialized form costing 25 ms/step at B=256 vs select's 1.15 ms
+# (docs/profile-r5.md). The two modes are bit-identical
+# (tests/test_search.py proves it on CPU).
+_SELECT_UPDATES = os.environ.get("FISHNET_TPU_SELECT_UPDATES", "1") != "0"
 
 # FISHNET_TPU_NO_PRUNING=1: disable null-move pruning, late-move
 # reductions AND futility pruning (debug/A-B lever; the oracle mirrors
@@ -84,7 +132,9 @@ _SELECT_UPDATES = bool(os.environ.get("FISHNET_TPU_SELECT_UPDATES"))
 #   expanding a single real child.
 # - LMR: late, quiet, unchecked moves search at reduced depth first and
 #   only re-search at full depth when the reduced result beats alpha.
-_PRUNING = not os.environ.get("FISHNET_TPU_NO_PRUNING")
+# ("" and "0" both leave pruning ON — same parse as SELECT_UPDATES, so
+# exporting the var as 0 never silently flips the search mode)
+_PRUNING = os.environ.get("FISHNET_TPU_NO_PRUNING", "") in ("", "0")
 NULL_R = 2  # base null-move depth reduction (+1 at depth_left >= 7)
 
 
@@ -100,7 +150,7 @@ def _is_quiet(move: jnp.ndarray, board_row: jnp.ndarray) -> jnp.ndarray:
 
 
 def _row_set(arr: jnp.ndarray, idx, row, mask) -> jnp.ndarray:
-    """arr (P, ...) ← row at position idx where mask (all unbatched;
+    """arr (R, ...) ← row at position idx where mask (all unbatched;
     vmapped over lanes). Scatter or one-hot select per _SELECT_UPDATES."""
     if not _SELECT_UPDATES:
         return arr.at[idx].set(jnp.where(mask, row, arr[idx]))
@@ -109,67 +159,51 @@ def _row_set(arr: jnp.ndarray, idx, row, mask) -> jnp.ndarray:
     return jnp.where(sel, row, arr)
 
 
+def _field_set(tab: jnp.ndarray, row_idx, field: int, val, mask) -> jnp.ndarray:
+    """tab (R, W): tab[row_idx, field] ← val where mask, as one fused
+    2-D one-hot select (no row read needed)."""
+    oh_r = (jnp.arange(tab.shape[0], dtype=jnp.int32) == row_idx) & mask
+    oh_f = jnp.arange(tab.shape[1], dtype=jnp.int32) == field
+    return jnp.where(oh_r[:, None] & oh_f[None, :], val, tab)
+
+
+def _board_from_row(row: jnp.ndarray) -> Board:
+    return Board(
+        board=row[BT_BOARD:BT_BOARD + 64],
+        stm=row[BT_STM],
+        ep=row[BT_EP],
+        castling=row[BT_CAST:BT_CAST + 4],
+        halfmove=row[BT_HM],
+        extra=row[BT_EXTRA:BT_EXTRA + 12],
+    )
+
+
+def _row_from_board(b: Board, ph1=None, ph2=None) -> jnp.ndarray:
+    z = jnp.zeros((1,), jnp.int32)
+    ph1 = z if ph1 is None else jnp.asarray(ph1, jnp.int32)[None]
+    ph2 = z if ph2 is None else jnp.asarray(ph2, jnp.int32)[None]
+    return jnp.concatenate([
+        b.board.astype(jnp.int32),
+        jnp.asarray(b.stm, jnp.int32)[None],
+        jnp.asarray(b.ep, jnp.int32)[None],
+        b.castling.astype(jnp.int32),
+        jnp.asarray(b.halfmove, jnp.int32)[None],
+        b.extra.astype(jnp.int32),
+        ph1, ph2,
+        jnp.zeros((BT_W - BT_PH2 - 1,), jnp.int32),
+    ])
+
+
 class SearchState(NamedTuple):
-    # stacks, leading dims (B, MAX_PLY[+1])
-    board: jnp.ndarray  # (B, P+1, 64) int32
-    stm: jnp.ndarray  # (B, P+1)
-    ep: jnp.ndarray  # (B, P+1)
-    castling: jnp.ndarray  # (B, P+1, 4)
-    halfmove: jnp.ndarray  # (B, P+1)
-    extra: jnp.ndarray  # (B, P+1, 12) variant side-state (board.EXTRA_*)
-    phash: jnp.ndarray  # (B, P+1, 2) uint32 path hashes (repetition scan)
+    bt: jnp.ndarray  # (B, P+1, BT_W) int32 board rows
+    nt: jnp.ndarray  # (B, P+1, NT_W) int32 per-node scalars
+    lane: jnp.ndarray  # (B, LN_W) int32 per-lane scalars
     hist_hash: jnp.ndarray  # (B, MAX_HIST, 2) uint32 pre-root game hashes
     hist_halfmove: jnp.ndarray  # (B, MAX_HIST) their halfmove counters
     moves: jnp.ndarray  # (B, P, MAX_MOVES) int32
-    count: jnp.ndarray  # (B, P)
-    midx: jnp.ndarray  # (B, P)
-    # per-node remaining depth (root row = lane depth limit; children get
-    # parent-1 minus any null-move/LMR reduction on push). Replaces the
-    # lane-global depth_limit - ply derivation so reductions can differ
-    # per node — the enabler for null-move pruning and LMR.
-    depth_left: jnp.ndarray  # (B, P+1)
-    null_st: jnp.ndarray  # (B, P) 0 none/spent, 1 pending, 2 in flight
-    last_red: jnp.ndarray  # (B, P) reduction applied to last pushed child
-    research: jnp.ndarray  # (B,) bool: re-push last child at full depth
-    killers: jnp.ndarray  # (B, P, 2) killer-move slots per ply (-1 empty)
     hist: jnp.ndarray  # (B, 4096) from|to-indexed history counters
-    searched: jnp.ndarray  # (B, P) legal children folded so far
-    alpha: jnp.ndarray  # (B, P) int32
-    alpha0: jnp.ndarray  # (B, P) window lower bound at entry (for TT flags)
-    beta: jnp.ndarray  # (B, P)
-    best: jnp.ndarray  # (B, P)
-    best_move: jnp.ndarray  # (B, P)
-    incheck: jnp.ndarray  # (B, P) bool
     pv: jnp.ndarray  # (B, P, P) int32
-    pv_len: jnp.ndarray  # (B, P)
-    acc: jnp.ndarray  # (B, P+1, 2, L1) f32 incremental NNUE accumulators
-    ply: jnp.ndarray  # (B,)
-    mode: jnp.ndarray  # (B,)
-    ret: jnp.ndarray  # (B,) value returned by just-finished node
-    ret_depth: jnp.ndarray  # (B,) searched depth of that value (-1: from TT)
-    # leaf evals fold into their parent within ONE step (ENTER→RETURN
-    # cascade), so they are never visible at a step boundary; the step
-    # marks them here and the TT runner stores them with the pre-step hash
-    store_mark: jnp.ndarray  # (B,) bool: this step produced a leaf eval
-    store_val: jnp.ndarray  # (B,) its static eval
-    nodes: jnp.ndarray  # (B,) int32 visited nodes
-    depth_limit: jnp.ndarray  # (B,)
-    node_budget: jnp.ndarray  # (B,)
-    root_score: jnp.ndarray  # (B,)
-    root_move: jnp.ndarray  # (B,)
-    root_alpha: jnp.ndarray  # (B,) aspiration window at the root
-    root_beta: jnp.ndarray  # (B,)
-
-
-def _board_at(s: SearchState, ply: jnp.ndarray) -> Board:
-    return Board(
-        board=s.board[ply],
-        stm=s.stm[ply],
-        ep=s.ep[ply],
-        castling=s.castling[ply],
-        halfmove=s.halfmove[ply],
-        extra=s.extra[ply],
-    )
+    acc: jnp.ndarray  # (B, P+1, 2, L1) incremental NNUE accumulators
 
 
 def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
@@ -200,60 +234,48 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
     acc = jnp.zeros((B, P + 1, 2, l1), adt)
     acc = acc.at[:, 0].set(root_acc.astype(adt))
 
-    def z(*shape, dtype=jnp.int32, fill=0):
-        return jnp.full((B, *shape), fill, dtype=dtype)
+    bt = jnp.zeros((B, P + 1, BT_W), jnp.int32)
+    bt = bt.at[:, :, BT_EP].set(-1)
+    bt = bt.at[:, :, BT_CAST:BT_CAST + 4].set(-1)
+    root_rows = jax.vmap(_row_from_board)(roots)
+    bt = bt.at[:, 0].set(root_rows)
 
-    board = z(P + 1, 64)
-    board = board.at[:, 0].set(roots.board)
-    stm = z(P + 1)
-    stm = stm.at[:, 0].set(roots.stm)
-    ep = z(P + 1, fill=-1)
-    ep = ep.at[:, 0].set(roots.ep)
-    castling = z(P + 1, 4, fill=-1)
-    castling = castling.at[:, 0].set(roots.castling)
-    halfmove = z(P + 1)
-    halfmove = halfmove.at[:, 0].set(roots.halfmove)
-    extra = z(P + 1, 12)
-    extra = extra.at[:, 0].set(roots.extra)
-    phash = jnp.zeros((B, P + 1, 2), jnp.uint32)
+    nt = jnp.zeros((B, P + 1, NT_W), jnp.int32)
+    nt = nt.at[:, :, NT_ALPHA].set(-INF)
+    nt = nt.at[:, :, NT_ALPHA0].set(-INF)
+    nt = nt.at[:, :, NT_BETA].set(INF)
+    nt = nt.at[:, :, NT_BEST].set(-INF)
+    nt = nt.at[:, :, NT_BMOVE].set(-1)
+    nt = nt.at[:, :, NT_K0].set(-1)
+    nt = nt.at[:, :, NT_K1].set(-1)
+    nt = nt.at[:, 0, NT_DL].set(depth.astype(jnp.int32))
+
+    lane = jnp.zeros((B, LN_W), jnp.int32)
+    lane = lane.at[:, LN_DLIM].set(depth.astype(jnp.int32))
+    lane = lane.at[:, LN_BUDGET].set(node_budget.astype(jnp.int32))
+    lane = lane.at[:, LN_RSCORE].set(-INF)
+    lane = lane.at[:, LN_RMOVE].set(-1)
+    lane = lane.at[:, LN_RALPHA].set(
+        jnp.full((B,), -INF, jnp.int32) if root_alpha is None
+        else jnp.asarray(root_alpha, jnp.int32)
+    )
+    lane = lane.at[:, LN_RBETA].set(
+        jnp.full((B,), INF, jnp.int32) if root_beta is None
+        else jnp.asarray(root_beta, jnp.int32)
+    )
+
     if hist_hash is None:
         hist_hash = jnp.zeros((B, MAX_HIST, 2), jnp.uint32)
     if hist_halfmove is None:
         hist_halfmove = jnp.full((B, MAX_HIST), HIST_HM_SENTINEL, jnp.int32)
     return SearchState(
-        board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
-        extra=extra, phash=phash,
+        bt=bt, nt=nt, lane=lane,
         hist_hash=jnp.asarray(hist_hash, jnp.uint32),
         hist_halfmove=jnp.asarray(hist_halfmove, jnp.int32),
-        moves=z(P, max_moves_for(variant), fill=-1),
-        count=z(P), midx=z(P),
-        depth_left=jnp.concatenate(
-            [depth.astype(jnp.int32)[:, None], jnp.zeros((B, P), jnp.int32)],
-            axis=1,
-        ),
-        null_st=z(P), last_red=z(P),
-        research=z(dtype=jnp.bool_),
-        killers=z(P, 2, fill=-1), hist=z(4096),
-        searched=z(P),
-        alpha=z(P, fill=-INF), alpha0=z(P, fill=-INF), beta=z(P, fill=INF),
-        best=z(P, fill=-INF), best_move=z(P, fill=-1),
-        incheck=z(P, dtype=jnp.bool_),
-        pv=z(P, P, fill=-1), pv_len=z(P),
+        moves=jnp.full((B, P, max_moves_for(variant)), -1, jnp.int32),
+        hist=jnp.zeros((B, 4096), jnp.int32),
+        pv=jnp.full((B, P, P), -1, jnp.int32),
         acc=acc,
-        ply=z(), mode=z(), ret=z(), ret_depth=z(),
-        store_mark=z(dtype=jnp.bool_), store_val=z(),
-        nodes=z(),
-        depth_limit=depth.astype(jnp.int32),
-        node_budget=node_budget.astype(jnp.int32),
-        root_score=z(fill=-INF), root_move=z(fill=-1),
-        root_alpha=(
-            jnp.full((B,), -INF, jnp.int32) if root_alpha is None
-            else jnp.asarray(root_alpha, jnp.int32)
-        ),
-        root_beta=(
-            jnp.full((B,), INF, jnp.int32) if root_beta is None
-            else jnp.asarray(root_beta, jnp.int32)
-        ),
     )
 
 
@@ -262,31 +284,43 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
                variant: str = "standard") -> SearchState:
     """One state-machine step for a single lane (vmapped over B).
 
-    Every stack mutation is a masked *row-level* update (`at[ply].set` with
-    a where-selected row): tree-level conds/selects would force XLA to copy
-    whole (MAX_PLY, …) stacks per step, which dominates per-step cost.
+    The three phases keep their row state in registers: ENTER composes
+    the entered node's nt/bt rows, RETURN composes the parent's, and
+    TRYMOVE selects whichever row it acts on from those — so the whole
+    step issues four nt row writes, two bt row writes and one write each
+    to moves/pv/acc/hist, instead of ~30 per-array scatters (round-5
+    profile: per-op overhead dominated the step).
 
     tt_hit/tt_score: a usable transposition-table cutoff for this lane's
     current ENTER node (probed outside the vmap against the shared table);
     tt_move: stored best move for ordering (-1 when none). None → no TT.
     """
-    # ---------------------------------------------------------- phase ENTER
-    ply = s.ply
-    enter = s.mode == MODE_ENTER
+    lane = s.lane
+    ply0 = lane[LN_PLY]
+    mode0 = lane[LN_MODE]
+    nodes = lane[LN_NODES]
+    parent0 = jnp.maximum(ply0 - 1, 0)
+    P1 = s.bt.shape[0]  # P+1 rows
+    ntr0 = s.nt[ply0]
+    ntp0 = s.nt[parent0]
+    btr0 = s.bt[ply0]
+    btp0 = s.bt[parent0]
+    moves_p_row = s.moves[jnp.minimum(parent0, s.moves.shape[0] - 1)]
 
-    b = _board_at(s, ply)
+    # ---------------------------------------------------------- phase ENTER
+    enter = mode0 == MODE_ENTER
+    b = _board_from_row(btr0)
     us = b.stm
     # legality of the move that led here + check state + variant-rule
     # game end, all per the statically compiled variant (board.node_rules)
     illegal_raw, we_are_checked, term_kind = node_rules(b, variant)
-    parent_illegal = (ply > 0) & illegal_raw
-    depth_left = s.depth_left[ply]
-    parent_ix = jnp.maximum(ply - 1, 0)
+    parent_illegal = (ply0 > 0) & illegal_raw
+    depth_left = ntr0[NT_DL]
     # this node was reached by a null move: its window is the parent's
     # null-window (beta-1, beta) seen from this side — and it must not
     # null-move again (two passes in a row search the parent's position)
-    parent_null = (ply > 0) & (s.null_st[jnp.minimum(parent_ix, s.null_st.shape[0] - 1)] == 2)
-    over_budget = s.nodes >= s.node_budget
+    parent_null = (ply0 > 0) & (ntp0[NT_NULL] == 2)
+    over_budget = nodes >= lane[LN_BUDGET]
     fifty = b.halfmove >= 100
 
     # twofold repetition along the search path (reference behavior is
@@ -302,21 +336,22 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     h1, h2 = _tt_mod.hash_board(
         b.board, us, b.ep, b.castling, b.extra, variant
     )
-    phash = _row_set(s.phash, ply, jnp.stack([h1, h2]), enter)
-    ks = jnp.arange(s.phash.shape[0], dtype=jnp.int32)
-    chain_ok = (b.halfmove - s.halfmove[ks]) == (ply - ks)
+    h1i = jax.lax.bitcast_convert_type(h1, jnp.int32)
+    h2i = jax.lax.bitcast_convert_type(h2, jnp.int32)
+    ks = jnp.arange(P1, dtype=jnp.int32)
+    chain_ok = (b.halfmove - s.bt[:, BT_HM]) == (ply0 - ks)
     repet_path = jnp.any(
-        (ks < ply)
+        (ks < ply0)
         & chain_ok
-        & (s.phash[:, 0] == h1)
-        & (s.phash[:, 1] == h2)
+        & (s.bt[:, BT_PH1] == h1i)
+        & (s.bt[:, BT_PH2] == h2i)
     )
     # ... and against the pre-root game history: slot k sits at virtual
     # ply k - MAX_HIST, so the unbroken-reversible-chain condition is
     # halfmove distance == ply distance with that offset
     hk = jnp.arange(s.hist_halfmove.shape[0], dtype=jnp.int32)
     hist_chain = (b.halfmove - s.hist_halfmove) == (
-        ply + (s.hist_halfmove.shape[0] - hk)
+        ply0 + (s.hist_halfmove.shape[0] - hk)
     )
     repet_hist = jnp.any(
         hist_chain & (s.hist_hash[:, 0] == h1) & (s.hist_hash[:, 1] == h2)
@@ -324,17 +359,17 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     repet = enter & (repet_path | repet_hist)
     # window inherited from the parent (negamax flip); a null child runs
     # the parent's zero-width null-window (beta-1, beta) instead
-    entry_alpha = jnp.where(ply == 0, s.root_alpha, -s.beta[parent_ix])
+    entry_alpha = jnp.where(ply0 == 0, lane[LN_RALPHA], -ntp0[NT_BETA])
     entry_beta = jnp.where(
-        ply == 0, s.root_beta,
-        jnp.where(parent_null, 1 - s.beta[parent_ix], -s.alpha[parent_ix]),
+        ply0 == 0, lane[LN_RBETA],
+        jnp.where(parent_null, 1 - ntp0[NT_BETA], -ntp0[NT_ALPHA]),
     )
     # quiescence: past the nominal depth, keep expanding CAPTURES until
     # the position is quiet (gen_noisy == 0), the stack is full, or the
     # budget runs out — the standard horizon-effect fix, with stand-pat
     # as the floor (see the expand section below)
     in_qs = depth_left <= 0
-    stack_full = ply >= s.moves.shape[0]  # no moves row / child slot left
+    stack_full = ply0 >= s.moves.shape[0]  # no moves row / child slot left
 
     # leaf value: NNUE eval (or draw for 50-move). On the board768 fast
     # path the accumulator came down the stack incrementally and only the
@@ -343,7 +378,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # 4-slot incremental update scheme (move_piece_changes).
     if nnue.is_board768(params) and variant != "atomic":
         leaf_val = jnp.int32(
-            nnue.forward_from_acc(params, s.acc[ply], us, nnue.output_bucket(b.board))
+            nnue.forward_from_acc(params, s.acc[ply0], us, nnue.output_bucket(b.board))
         )
     else:
         leaf_val = jnp.int32(nnue.evaluate(params, b.board, us))
@@ -358,15 +393,15 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     leaf_val = jnp.where(
         vterm,
         jnp.where(
-            term_kind == TERM_LOSS, -(MATE - ply),
-            jnp.where(term_kind == TERM_WIN, MATE - ply, DRAW),
+            term_kind == TERM_LOSS, -(MATE - ply0),
+            jnp.where(term_kind == TERM_WIN, MATE - ply0, DRAW),
         ),
         leaf_val,
     )
 
     gen_moves, gen_count, gen_noisy = generate_moves(
         b, variant,
-        killers=s.killers[jnp.minimum(ply, s.killers.shape[0] - 1)],
+        killers=jnp.stack([ntr0[NT_K0], ntr0[NT_K1]]),
         hist=s.hist,
     )
     # futility pruning: at a frontier node (depth_left 1-2, not in check,
@@ -382,7 +417,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
             ~in_qs
             & (depth_left <= 2)
             & ~we_are_checked
-            & (ply > 0)
+            & (ply0 > 0)
             & (static_val + f_margin <= entry_alpha)
             & (entry_alpha > -(MATE - 1000))
             & (entry_alpha < MATE - 1000)
@@ -404,7 +439,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # repetition draws — the hash excludes the halfmove counter and the
     # path, so a stored score must not override a forced draw)
     use_tt = (
-        (tt_hit & (ply > 0) & ~fifty & ~repet & ~vterm)
+        (tt_hit & (ply0 > 0) & ~fifty & ~repet & ~vterm)
         if tt_hit is not None
         else jnp.bool_(False)
     )
@@ -428,7 +463,6 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # in QS, where the swap could pull a quiet move into the noisy prefix
     if tt_move is not None:
         tm_at = jnp.argmax(gen_moves == tt_move)
-        # ~qs_like: the swap could pull a quiet move into the noisy prefix
         tm_present = (tt_move >= 0) & (gen_moves[tm_at] == tt_move) & ~qs_like
         m0 = gen_moves[0]
         # dynamic-index swap routed through _row_set so the
@@ -439,30 +473,10 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
             jnp.where(tm_present, tt_move, gen_moves[0])
         )
 
-    def row_upd(arr, val, mask):
-        return _row_set(arr, ply, val, mask)
-
-    moves = _row_set(
-        s.moves, jnp.minimum(ply, s.moves.shape[0] - 1), gen_moves, expand
-    )
-    # QS (and futile) nodes expand only the noisy prefix of the move list
-    count = row_upd(s.count, jnp.where(qs_like, gen_noisy, gen_count), expand)
-    midx = row_upd(s.midx, 0, expand)
-    searched = row_upd(s.searched, 0, expand)
     # stand-pat: in QS the node may decline every capture and keep the
     # static eval, so it floors both best and alpha (futile nodes reuse
     # the same floor; their static sits below alpha by construction, so
     # only `best` actually moves — the fail-soft return value)
-    qs_floor = qs_like & expand
-    alpha = row_upd(
-        s.alpha,
-        jnp.where(qs_floor, jnp.maximum(entry_alpha, leaf_val), entry_alpha),
-        expand,
-    )
-    alpha0 = row_upd(s.alpha0, entry_alpha, expand)
-    beta = row_upd(s.beta, entry_beta, expand)
-    best = row_upd(s.best, jnp.where(qs_floor, leaf_val, -INF), expand)
-    best_move = row_upd(s.best_move, -1, expand)
     # null-move eligibility (Stockfish search.cpp nullMove conditions,
     # minus the zugzwang verification search): interior node, depth to
     # spare, not in check, not already inside a null subtree, static
@@ -481,20 +495,49 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
             & (depth_left >= 3)
             & ~we_are_checked
             & ~parent_null
-            & (ply > 0)
+            & (ply0 > 0)
             & (static_val >= entry_beta)
             & (entry_beta < MATE - 1000)
             & (entry_beta > -(MATE - 1000))
             & nonpawn
         )
-        null_st = row_upd(s.null_st, jnp.where(nmp_ok, 1, 0), expand)
+        null_v = jnp.where(nmp_ok, 1, 0)
     else:
-        null_st = row_upd(s.null_st, 0, expand)
-    last_red = row_upd(s.last_red, 0, expand)
-    incheck = row_upd(s.incheck, we_are_checked, enter)
-    # leaf nodes must also zero pv_len: the fold at the parent reads
-    # pv_len[child_ply], which would otherwise be a stale slot
-    pv_len = row_upd(s.pv_len, 0, enter)
+        null_v = jnp.int32(0)
+
+    # the entered node's nt row, composed once: fields in _FM_EXPAND take
+    # their expansion value under `expand`, _FM_ENTER fields under
+    # `enter`, everything else keeps its old value — so one full-row
+    # write under `enter` reproduces the per-field write masks exactly
+    nv = jnp.stack([
+        jnp.where(qs_like, gen_noisy, gen_count),            # NT_COUNT
+        jnp.int32(0),                                        # NT_MIDX
+        jnp.int32(0),                                        # NT_SEARCHED
+        jnp.where(qs_like, jnp.maximum(entry_alpha, leaf_val),
+                  entry_alpha),                              # NT_ALPHA
+        entry_alpha,                                         # NT_ALPHA0
+        entry_beta,                                          # NT_BETA
+        jnp.where(qs_like, leaf_val, -INF),                  # NT_BEST
+        jnp.int32(-1),                                       # NT_BMOVE
+        null_v,                                              # NT_NULL
+        jnp.int32(0),                                        # NT_LASTRED
+        jnp.int32(0),                                        # NT_PVLEN
+        ntr0[NT_DL],                                         # NT_DL
+        we_are_checked.astype(jnp.int32),                    # NT_INCHECK
+        ntr0[NT_K0],                                         # NT_K0
+        ntr0[NT_K1],                                         # NT_K1
+        jnp.int32(0),
+    ])
+    sel = (jnp.asarray(_FM_EXPAND) & expand) | (jnp.asarray(_FM_ENTER) & enter)
+    ntE = jnp.where(sel, nv, ntr0)
+    nt_new = _row_set(s.nt, ply0, ntE, enter)
+
+    btE = btr0.at[BT_PH1].set(h1i).at[BT_PH2].set(h2i)
+    bt_new = _row_set(s.bt, ply0, btE, enter)
+    moves_new = _row_set(
+        s.moves, jnp.minimum(ply0, s.moves.shape[0] - 1), gen_moves, expand
+    )
+
     ret = jnp.where(
         enter & to_return,
         jnp.where(
@@ -503,69 +546,77 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
             jnp.where(use_tt, tt_score, leaf_val) if tt_score is not None
             else leaf_val,
         ),
-        s.ret,
+        lane[LN_RET],
     )
     # ret_depth: 0 for static leaves, -1 for TT-sourced values (already in
     # the table — don't re-store them)
     ret_depth = jnp.where(
-        enter & to_return, jnp.where(use_tt, -1, 0), s.ret_depth
+        enter & to_return, jnp.where(use_tt, -1, 0), lane[LN_RETD]
     )
-    nodes = s.nodes + jnp.where(enter & ~parent_illegal, 1, 0)
+    nodes = nodes + jnp.where(enter & ~parent_illegal, 1, 0)
     mode = jnp.where(
-        enter, jnp.where(to_return, MODE_RETURN, MODE_TRYMOVE), s.mode
+        enter, jnp.where(to_return, MODE_RETURN, MODE_TRYMOVE), mode0
     )
 
     # --------------------------------------------------------- phase RETURN
-    # the node at `ply` finished with value `ret` (from its stm's view)
+    # the node at ply0 finished with value `ret` (from its stm's view);
+    # it folds into parent0
     ret_m = mode == MODE_RETURN
-    at_root = ply == 0
-    parent = jnp.maximum(ply - 1, 0)
+    at_root = ply0 == 0
     was_illegal = ret == ILLEGAL
     v = -ret
-    tried = moves[parent, jnp.maximum(midx[parent] - 1, 0)]
+    tried = moves_p_row[jnp.maximum(ntp0[NT_MIDX] - 1, 0)]
     # the child that just returned was the parent's null move: score it
     # against beta only — a fail-high ends the parent (unproven-mate
     # guard: never cut on a mate-range null score), a fail-low is simply
     # discarded. Either way it folds into nothing: no best_move, no pv,
     # no searched credit.
-    is_null_ret = ret_m & ~at_root & (null_st[parent] == 2)
+    is_null_ret = ret_m & ~at_root & (ntp0[NT_NULL] == 2)
     null_cut = (
-        is_null_ret & ~was_illegal & (v >= beta[parent]) & (v < MATE - 1000)
+        is_null_ret & ~was_illegal & (v >= ntp0[NT_BETA]) & (v < MATE - 1000)
     )
     # LMR re-search: the last child was depth-reduced and its reduced
     # score beat alpha — discard the fold and re-push it at full depth
     need_rs = (
         ret_m & ~at_root & ~was_illegal & ~is_null_ret
-        & (last_red[parent] > 0) & (v > alpha[parent])
+        & (ntp0[NT_LASTRED] > 0) & (v > ntp0[NT_ALPHA])
     )
     better = (
-        ret_m & (~at_root) & (~was_illegal) & (v > best[parent])
+        ret_m & (~at_root) & (~was_illegal) & (v > ntp0[NT_BEST])
         & ~is_null_ret & ~need_rs
     )
     fold = ret_m & ~at_root
 
-    best = _row_set(best, parent, v, better | null_cut)
-    best_move = _row_set(best_move, parent, tried, better)
-    alpha = _row_set(
-        alpha, parent, jnp.maximum(alpha[parent], best[parent]), fold
+    best_p = jnp.where(better | null_cut, v, ntp0[NT_BEST])
+    bmove_p = jnp.where(better, tried, ntp0[NT_BMOVE])
+    alpha_p = jnp.where(
+        fold, jnp.maximum(ntp0[NT_ALPHA], best_p), ntp0[NT_ALPHA]
     )
-    searched = _row_set(
-        searched, parent, searched[parent] + 1,
-        fold & ~was_illegal & ~is_null_ret & ~need_rs,
+    searched_p = ntp0[NT_SEARCHED] + jnp.where(
+        fold & ~was_illegal & ~is_null_ret & ~need_rs, 1, 0
     )
-    null_st = _row_set(null_st, parent, 0, is_null_ret)
-    research = jnp.where(ret_m, need_rs, s.research)
-    # pv[parent] = tried + pv[ply]
-    new_pv_row = jnp.concatenate([tried[None], s.pv[ply][:-1]])
-    pv = _row_set(s.pv, parent, new_pv_row, better)
-    pv_len = _row_set(
-        pv_len, parent, jnp.minimum(pv_len[ply] + 1, s.pv.shape[-1]), better
+    null_p = jnp.where(is_null_ret, 0, ntp0[NT_NULL])
+    # pv[parent] = tried + pv[ply]; pv_len[ply] is the post-ENTER value
+    # (a leaf that entered this same step zeroed it)
+    pvlen_child = ntE[NT_PVLEN]
+    pvlen_p = jnp.where(
+        better, jnp.minimum(pvlen_child + 1, s.pv.shape[-1]), ntp0[NT_PVLEN]
     )
+    ntP = ntp0
+    for f_ix, f_val in ((NT_BEST, best_p), (NT_BMOVE, bmove_p),
+                        (NT_ALPHA, alpha_p), (NT_SEARCHED, searched_p),
+                        (NT_NULL, null_p), (NT_PVLEN, pvlen_p)):
+        ntP = ntP.at[f_ix].set(f_val)
+    nt_new = _row_set(nt_new, parent0, ntP, fold)
+
+    new_pv_row = jnp.concatenate([tried[None], s.pv[ply0][:-1]])
+    pv_new = _row_set(s.pv, parent0, new_pv_row, better)
+    research = jnp.where(ret_m, need_rs, lane[LN_RESEARCH] != 0)
     # root: record and park (ret, not best[0] — ret carries the
     # mate/stalemate value when the root had no legal moves)
-    root_score = jnp.where(ret_m & at_root, ret, s.root_score)
-    root_move = jnp.where(ret_m & at_root, best_move[0], s.root_move)
-    ply = jnp.where(fold, parent, ply)
+    root_score = jnp.where(ret_m & at_root, ret, lane[LN_RSCORE])
+    root_move = jnp.where(ret_m & at_root, ntp0[NT_BMOVE], lane[LN_RMOVE])
+    ply1 = jnp.where(fold, parent0, ply0)
     mode = jnp.where(
         ret_m, jnp.where(at_root, MODE_DONE, MODE_TRYMOVE), mode
     )
@@ -575,33 +626,40 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # evals), not here — finishing a node early with searched==0 would
     # return -INF garbage to the parent
     try_m = mode == MODE_TRYMOVE
-    exhausted = midx[ply] >= count[ply]
-    cutoff = alpha[ply] >= beta[ply]
+    # the row TRYMOVE acts on: the freshly-expanded node (ENTER cascade)
+    # or the freshly-folded parent (RETURN cascade) — both in registers
+    came_from_enter = enter & expand
+    nt1 = jnp.where(came_from_enter, ntE, ntP)
+    moves_row1 = jnp.where(came_from_enter, gen_moves, moves_p_row)
+    bt1 = jnp.where(came_from_enter, btE, btp0)
+    parent_b = _board_from_row(bt1)
+    exhausted = nt1[NT_MIDX] >= nt1[NT_COUNT]
+    cutoff = nt1[NT_ALPHA] >= nt1[NT_BETA]
     # a pending null move is tried BEFORE the first real move; an LMR
     # re-push (research, set by RETURN this same step) re-enters the
     # previous move at full depth and overrides finish — exhausted may
     # already be true when the reduced move was the last one
     re_push = try_m & research
-    do_null = try_m & ~re_push & (null_st[ply] == 1) & ~cutoff
+    do_null = try_m & ~re_push & (nt1[NT_NULL] == 1) & ~cutoff
     finish = (exhausted | cutoff) & ~do_null & ~re_push
     advance = try_m & ~finish
     normal_adv = advance & ~re_push & ~do_null
-    dl_node = s.depth_left[ply]
+    dl_node = nt1[NT_DL]
 
     # killer/history credit on fail-high: the quiet move that raised
     # alpha >= beta becomes killer slot 0 for this ply and earns a
     # depth²-weighted history bump (captures already order by MVV-LVA;
     # en-passant reads as quiet here, which only costs ordering)
-    cause = best_move[ply]
-    c_quiet = (cause >= 0) & _is_quiet(cause, s.board[ply])
+    cause = nt1[NT_BMOVE]
+    c_quiet = (cause >= 0) & _is_quiet(cause, bt1[BT_BOARD:BT_BOARD + 64])
     k_upd = try_m & cutoff & c_quiet
-    k0 = s.killers[ply, 0]
-    new_row = jnp.stack([cause, jnp.where(cause == k0, s.killers[ply, 1], k0)])
-    killers = _row_set(s.killers, ply, new_row, k_upd & (cause != k0))
+    k_new = k_upd & (cause != nt1[NT_K0])
+    k0_v = jnp.where(k_new, cause, nt1[NT_K0])
+    k1_v = jnp.where(k_new, nt1[NT_K0], nt1[NT_K1])
     h_idx = jnp.clip(cause, 0) & 4095
     dl = jnp.maximum(dl_node, 0)
     h_w = jnp.minimum(dl * dl + 1, 1024)
-    hist = _row_set(
+    hist_new = _row_set(
         s.hist, h_idx, jnp.minimum(s.hist[h_idx] + h_w, 1 << 20), k_upd
     )
 
@@ -612,38 +670,33 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     # best == -INF guards the count==0 + null-cutoff corner: a null-move
     # fail-high set best without any legal child being searched, and the
     # node must return that score, not a phantom mate/stalemate
-    no_legal = (searched[ply] == 0) & ~node_in_qs & (best[ply] == -INF)
+    no_legal = (nt1[NT_SEARCHED] == 0) & ~node_in_qs & (nt1[NT_BEST] == -INF)
     if variant == "antichess":
         # losing chess: the side with no moves left (stalemated or out of
         # pieces) WINS (host: AntichessPosition._variant_outcome)
-        mate_val = MATE - ply
+        mate_val = MATE - ply1
     else:
-        mate_val = jnp.where(incheck[ply], -(MATE - ply), DRAW)
-    fin_val = jnp.where(no_legal & exhausted, mate_val, best[ply])
+        mate_val = jnp.where(nt1[NT_INCHECK] != 0, -(MATE - ply1), DRAW)
+    fin_val = jnp.where(no_legal & exhausted, mate_val, nt1[NT_BEST])
 
     m_ix = jnp.where(
         re_push,
-        jnp.maximum(midx[ply] - 1, 0),
-        jnp.minimum(midx[ply], moves.shape[-1] - 1),
+        jnp.maximum(nt1[NT_MIDX] - 1, 0),
+        jnp.minimum(nt1[NT_MIDX], moves_row1.shape[0] - 1),
     )
-    move = moves[ply, m_ix]
-    parent_b = Board(
-        board=s.board[ply], stm=s.stm[ply], ep=s.ep[ply],
-        castling=s.castling[ply], halfmove=s.halfmove[ply],
-        extra=s.extra[ply],
-    )
+    move = moves_row1[m_ix]
     child = make_move(parent_b, jnp.maximum(move, 0), variant)
     # late-move reduction: late, quiet, unchecked moves of a deep-enough
     # node search 1 ply shallower (2 from move 8); RETURN re-pushes at
     # full depth when the reduced score beats alpha
     if _PRUNING:
-        m_quiet = _is_quiet(jnp.maximum(move, 0), s.board[ply])
+        m_quiet = _is_quiet(jnp.maximum(move, 0), bt1[BT_BOARD:BT_BOARD + 64])
         lmr_ok = (
-            (dl_node >= 3) & (midx[ply] >= 3) & m_quiet
-            & ~incheck[ply] & ~node_in_qs
+            (dl_node >= 3) & (nt1[NT_MIDX] >= 3) & m_quiet
+            & (nt1[NT_INCHECK] == 0) & ~node_in_qs
         )
         red = jnp.where(
-            lmr_ok, jnp.where(midx[ply] >= 8, 2, 1), 0
+            lmr_ok, jnp.where(nt1[NT_MIDX] >= 8, 2, 1), 0
         )
         red = jnp.where(re_push | do_null, 0, red)
         # the null child: same position, opponent to move, no ep, and a
@@ -664,19 +717,26 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
     else:
         red = jnp.int32(0)
         child_dl = jnp.maximum(dl_node - 1, 0)
-    nply = jnp.minimum(ply + 1, s.board.shape[0] - 1)
+    nply = jnp.minimum(ply1 + 1, P1 - 1)
 
-    midx = _row_set(midx, ply, midx[ply] + 1, normal_adv)
-    null_st = _row_set(null_st, ply, 2, do_null)
-    last_red = _row_set(last_red, ply, red, advance)
+    # TRYMOVE's own-row write (midx/null/lastred/killers), then the
+    # child-push writes: depth_left of the pushed row (a single-field
+    # 2-D one-hot — the row's other fields belong to the OLD node there
+    # and are rewritten when the child expands), its board row, and its
+    # incremental accumulator
+    nt1w = nt1
+    for f_ix, f_val in (
+        (NT_MIDX, jnp.where(normal_adv, nt1[NT_MIDX] + 1, nt1[NT_MIDX])),
+        (NT_NULL, jnp.where(do_null, 2, nt1[NT_NULL])),
+        (NT_LASTRED, jnp.where(advance, red, nt1[NT_LASTRED])),
+        (NT_K0, k0_v), (NT_K1, k1_v),
+    ):
+        nt1w = nt1w.at[f_ix].set(f_val)
+    nt_new = _row_set(nt_new, ply1, nt1w, try_m)
+    nt_new = _field_set(nt_new, nply, NT_DL, child_dl, advance)
     research = jnp.where(try_m, jnp.bool_(False), research)
-    depth_left = _row_set(s.depth_left, nply, child_dl, advance)
-    board = _row_set(s.board, nply, child.board, advance)
-    stm = _row_set(s.stm, nply, child.stm, advance)
-    ep = _row_set(s.ep, nply, child.ep, advance)
-    castling = _row_set(s.castling, nply, child.castling, advance)
-    halfmove = _row_set(s.halfmove, nply, child.halfmove, advance)
-    extra_st = _row_set(s.extra, nply, child.extra, advance)
+
+    bt_new = _row_set(bt_new, nply, _row_from_board(child), advance)
     if nnue.is_board768(params) and variant != "atomic":
         codes, sqs, signs = move_piece_changes(
             parent_b, jnp.maximum(move, 0), variant
@@ -686,34 +746,31 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
             # incremental update an exact no-op (code 0 → no-op)
             codes = jnp.where(do_null, 0, codes)
             signs = jnp.where(do_null, 0, signs)
-        child_acc = nnue.apply_acc_updates_768(params, s.acc[ply], codes, sqs, signs)
-        acc = _row_set(s.acc, nply, child_acc, advance)
+        child_acc = nnue.apply_acc_updates_768(params, s.acc[ply1], codes, sqs, signs)
+        acc_new = _row_set(s.acc, nply, child_acc, advance)
     else:
-        acc = s.acc
+        acc_new = s.acc
 
     ret = jnp.where(try_m & finish, fin_val, ret)
     ret_depth = jnp.where(try_m & finish, dl_node, ret_depth)
     mode = jnp.where(
         try_m, jnp.where(finish, MODE_RETURN, MODE_ENTER), mode
     )
-    ply = jnp.where(advance, nply, ply)
+    ply_f = jnp.where(advance, nply, ply1)
+
+    lane_new = jnp.stack([
+        ply_f, mode, ret, ret_depth,
+        store_mark.astype(jnp.int32), store_val,
+        nodes, lane[LN_DLIM], lane[LN_BUDGET],
+        root_score, root_move, lane[LN_RALPHA], lane[LN_RBETA],
+        research.astype(jnp.int32),
+        jnp.int32(0), jnp.int32(0),
+    ])
 
     return SearchState(
-        board=board, stm=stm, ep=ep, castling=castling, halfmove=halfmove,
-        extra=extra_st, phash=phash,
+        bt=bt_new, nt=nt_new, lane=lane_new,
         hist_hash=s.hist_hash, hist_halfmove=s.hist_halfmove,
-        moves=moves, count=count, midx=midx,
-        depth_left=depth_left, null_st=null_st, last_red=last_red,
-        research=research,
-        killers=killers, hist=hist,
-        searched=searched,
-        alpha=alpha, alpha0=alpha0, beta=beta, best=best, best_move=best_move,
-        incheck=incheck, pv=pv, pv_len=pv_len, acc=acc,
-        ply=ply, mode=mode, ret=ret, ret_depth=ret_depth,
-        store_mark=store_mark, store_val=store_val, nodes=nodes,
-        depth_limit=s.depth_limit, node_budget=s.node_budget,
-        root_score=root_score, root_move=root_move,
-        root_alpha=s.root_alpha, root_beta=s.root_beta,
+        moves=moves_new, hist=hist_new, pv=pv_new, acc=acc_new,
     )
 
 
@@ -779,42 +836,42 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
 
         def body(carry):
             s, t, i = carry
-            bb = _gather_ply(s.board, s.ply)
-            st = _gather_ply(s.stm, s.ply)
-            epv = _gather_ply(s.ep, s.ply)
-            ca = _gather_ply(s.castling, s.ply)
-            ex = _gather_ply(s.extra, s.ply)
+            lane = s.lane
+            ply = lane[:, LN_PLY]
+            btrow = _gather_ply(s.bt, ply)  # one row gather serves all
             h1, h2 = jax.vmap(
-                lambda b_, s_, e_, c_, x_: _tt_mod.hash_board(
-                    b_, s_, e_, c_, x_, variant
+                lambda r: _tt_mod.hash_board(
+                    r[BT_BOARD:BT_BOARD + 64], r[BT_STM], r[BT_EP],
+                    r[BT_CAST:BT_CAST + 4], r[BT_EXTRA:BT_EXTRA + 12],
+                    variant,
                 )
-            )(bb, st, epv, ca, ex)
+            )(btrow)
 
             # ---- store lanes whose INTERIOR node just finished. (Leaf
             # returns fold into the parent within one step — the ENTER→
             # RETURN cascade — so a lane parked in RETURN here always
             # carries ret_depth >= 1, except TT-sourced values at -1.)
-            ret_m = s.mode == MODE_RETURN
+            ret_m = lane[:, LN_MODE] == MODE_RETURN
             store_mask = (
                 ret_m
-                & (s.ret != ILLEGAL)
-                & (s.ret_depth >= 1)  # -1: value came from the TT itself
+                & (lane[:, LN_RET] != ILLEGAL)
+                & (lane[:, LN_RETD] >= 1)  # -1: value came from the TT
                 # after budget exhaustion subtrees are degraded — their
                 # values are shallow despite the nominal depth label
-                & (s.nodes < s.node_budget)
+                & (lane[:, LN_NODES] < lane[:, LN_BUDGET])
             )
-            beta_at = _gather_ply(s.beta, s.ply)
-            alpha0_at = _gather_ply(s.alpha0, s.ply)
+            ntrow = _gather_ply(s.nt, ply)
             flag = jnp.where(
-                s.ret >= beta_at,
+                lane[:, LN_RET] >= ntrow[:, NT_BETA],
                 _tt_mod.FLAG_LOWER,
                 jnp.where(
-                    s.ret <= alpha0_at, _tt_mod.FLAG_UPPER, _tt_mod.FLAG_EXACT
+                    lane[:, LN_RET] <= ntrow[:, NT_ALPHA0],
+                    _tt_mod.FLAG_UPPER, _tt_mod.FLAG_EXACT,
                 ),
             )
-            bm = _gather_ply(s.best_move, s.ply)
             t = _tt_mod.store(
-                t, h1, h2, s.ret, jnp.maximum(s.ret_depth, 0), flag, bm,
+                t, h1, h2, lane[:, LN_RET],
+                jnp.maximum(lane[:, LN_RETD], 0), flag, ntrow[:, NT_BMOVE],
                 store_mask,
             )
 
@@ -823,22 +880,21 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             # node — incl. the zero-width null window for null children,
             # or stored LOWER bounds inside [1-beta_p, -alpha_p) would
             # miss valid null-search fail-high cutoffs
-            enter = s.mode == MODE_ENTER
-            parent = jnp.maximum(s.ply - 1, 0)
-            pnull = (s.ply > 0) & (_gather_ply(s.null_st, parent) == 2)
+            enter = lane[:, LN_MODE] == MODE_ENTER
+            parent = jnp.maximum(ply - 1, 0)
+            ntprow = _gather_ply(s.nt, parent)
+            pnull = (ply > 0) & (ntprow[:, NT_NULL] == 2)
             a_w = jnp.where(
-                s.ply == 0, s.root_alpha, -_gather_ply(s.beta, parent)
+                ply == 0, lane[:, LN_RALPHA], -ntprow[:, NT_BETA]
             )
             b_w = jnp.where(
-                s.ply == 0, s.root_beta,
+                ply == 0, lane[:, LN_RBETA],
                 jnp.where(
-                    pnull,
-                    1 - _gather_ply(s.beta, parent),
-                    -_gather_ply(s.alpha, parent),
+                    pnull, 1 - ntprow[:, NT_BETA], -ntprow[:, NT_ALPHA]
                 ),
             )
             usable, score, _mv, order_mv = _tt_mod.probe(
-                t, h1, h2, _gather_ply(s.depth_left, s.ply), a_w, b_w,
+                t, h1, h2, ntrow[:, NT_DL], a_w, b_w,
                 deep_bounds=deep_tt,
             )
             usable &= enter
@@ -848,16 +904,17 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             # ---- store leaves the step just evaluated (depth-0 EXACT).
             # Their hash is the PRE-step hash: a marking lane was in ENTER
             # at this ply, exactly the position h1/h2 were computed for.
+            sval = s.lane[:, LN_SVAL]
             t = _tt_mod.store(
-                t, h1, h2, s.store_val, jnp.zeros_like(s.store_val),
-                jnp.full_like(s.store_val, _tt_mod.FLAG_EXACT),
-                jnp.full_like(s.store_val, -1), s.store_mark,
+                t, h1, h2, sval, jnp.zeros_like(sval),
+                jnp.full_like(sval, _tt_mod.FLAG_EXACT),
+                jnp.full_like(sval, -1), s.lane[:, LN_SMARK] != 0,
             )
             return s, t, i + 1
 
     def cond(carry):
         s, t, i = carry
-        return (i < segment_steps) & jnp.any(s.mode != MODE_DONE)
+        return (i < segment_steps) & jnp.any(s.lane[:, LN_MODE] != MODE_DONE)
 
     state, ttab, n = jax.lax.while_loop(
         cond, body, (state, ttab, jnp.int32(0))
@@ -873,12 +930,12 @@ _init_state_jit = jax.jit(init_state, static_argnames=("max_ply", "variant"))
 
 def extract_results(state: SearchState, steps) -> dict:
     return {
-        "score": state.root_score,
-        "move": state.root_move,
+        "score": state.lane[:, LN_RSCORE],
+        "move": state.lane[:, LN_RMOVE],
         "pv": state.pv[:, 0],
-        "pv_len": state.pv_len[:, 0],
-        "nodes": state.nodes,
-        "done": state.mode == MODE_DONE,
+        "pv_len": state.nt[:, 0, NT_PVLEN],
+        "nodes": state.lane[:, LN_NODES],
+        "done": state.lane[:, LN_MODE] == MODE_DONE,
         "steps": steps,
     }
 

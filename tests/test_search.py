@@ -148,7 +148,7 @@ def test_pruning_reduces_nodes(params):
     )
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = {}
-    for flag in ("", "1"):
+    for flag in ("0", "1"):
         env = dict(os.environ)
         env["FISHNET_TPU_NO_PRUNING"] = flag
         r = subprocess.run(
@@ -157,7 +157,7 @@ def test_pruning_reduces_nodes(params):
         )
         assert r.returncode == 0, r.stderr[-2000:]
         results[flag] = json.loads(r.stdout.splitlines()[-1])
-    assert results[""]["nodes"] < results["1"]["nodes"], results
+    assert results["0"]["nodes"] < results["1"]["nodes"], results
 
 
 def test_pv_is_legal_line(params):
@@ -266,7 +266,7 @@ def test_select_updates_mode_bit_identical(params):
     )
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     results = []
-    for flag in ("", "1"):
+    for flag in ("0", "1"):
         env = dict(os.environ)
         env["FISHNET_TPU_SELECT_UPDATES"] = flag
         r = subprocess.run(

@@ -51,7 +51,7 @@ def main() -> None:
 
     state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply,
                               "standard")
-    jax.block_until_ready(state.board)
+    jax.block_until_ready(state.bt)
 
     t0 = time.perf_counter()
     S._run_segment_jit.lower(params, state, None, steps, "standard",
@@ -64,10 +64,10 @@ def main() -> None:
         t0 = time.perf_counter()
         out, _, n = S._run_segment_jit(params, state, None, steps, "standard",
                                        False)
-        jax.block_until_ready(out.nodes)
+        jax.block_until_ready(out.lane)
         dt = time.perf_counter() - t0
         n = int(n)
-        nodes = int(np.asarray(out.nodes).sum())
+        nodes = int(np.asarray(out.lane[:, S.LN_NODES]).sum())
         print(f"{tag}: {n} steps in {dt*1e3:.1f}ms -> {dt/max(n,1)*1e6:.0f}"
               f" us/step, {nodes} nodes, {nodes/dt:.0f} nps", file=sys.stderr)
 
@@ -78,7 +78,7 @@ def main() -> None:
     with jax.profiler.trace(trace_dir):
         out, _, n = S._run_segment_jit(params, state, None, steps, "standard",
                                        False)
-        jax.block_until_ready(out.nodes)
+        jax.block_until_ready(out.lane)
     print(f"trace written to {trace_dir}", file=sys.stderr)
 
     # aggregate per-op durations from the chrome trace
