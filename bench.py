@@ -29,7 +29,8 @@ import time
 # (64,3)/(128,3)/(256,3) middle shapes added in round 5 (VERDICT r4 weak #2:
 # the round-4 ramp had no middle shape, so when (256,4) died the recorded
 # headline under-reported the same session's matrix numbers by ~3x)
-STAGES = [(8, 2), (64, 2), (64, 3), (128, 3), (256, 3), (256, 4)]
+STAGES = [(8, 2), (64, 2), (64, 3), (128, 3), (256, 3), (256, 4),
+          (512, 3), (1024, 3)]
 
 # Device stages run with FISHNET_TPU_SELECT_UPDATES=1 FIRST: the round-3
 # bisection (docs/tpu-hang.md) pinned the B>=16/max_ply>=4 hang/worker-crash
@@ -177,12 +178,27 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, variant)
     jax.block_until_ready(state.bt)
     _hb(t0, "compile_done init_state (and executed)")
-    seg = 20_000
+    # short segments let the lane-narrowing path retire finished lanes
+    # mid-batch (ops/search.py search_batch_resumable narrow=True) — with
+    # one 20k-step segment a depth-3 batch finishes before the first
+    # narrowing checkpoint and the finish-tail eats ~60% of wall clock
+    seg = int(os.environ.get("BENCH_SEG", "1024"))
     _hb(t0, f"compile_start run_segment(seg={seg})")
     lowered = S._run_segment_jit.lower(params, state, tt, seg, variant)
     _hb(t0, "  lowered")
     lowered.compile()
     _hb(t0, "compile_done run_segment")
+    # pre-compile every narrowed width down to the floor: the warmup and
+    # timed runs can take DIFFERENT narrowing trajectories (a warm TT
+    # changes when lanes finish), and a cold 10-40 s XLA compile landing
+    # inside the timed region would corrupt the recorded nps
+    w = B // 2
+    while w >= 64:
+        sub = jax.tree.map(lambda a: a[:w], state)
+        _hb(t0, f"compile_start run_segment(width={w})")
+        S._run_segment_jit.lower(params, sub, tt, seg, variant).compile()
+        w //= 2
+    _hb(t0, "compile_done narrowed widths")
 
     _hb(t0, "exec_start warmup search")
     out = S.search_batch_resumable(
@@ -303,7 +319,9 @@ def device_preflight(timeout: float = 120.0) -> bool:
 
 
 def main() -> None:
-    B = int(os.environ.get("BENCH_LANES", "256"))
+    # 1024 lanes = the measured v5e throughput sweet spot
+    # (docs/profile-r5.md; 2048 falls off a VMEM cliff)
+    B = int(os.environ.get("BENCH_LANES", "1024"))
     DEPTH = int(os.environ.get("BENCH_DEPTH", "4"))
     BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
     stage_timeout = float(os.environ.get("BENCH_STAGE_TIMEOUT", "420"))

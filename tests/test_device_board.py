@@ -135,10 +135,15 @@ def test_history_ordering_uses_correct_slot_both_colors():
     for fen, uci in cases:
         pos = Position.from_fen(fen)
         mv = encode_host_move(pos.parse_uci(uci))
-        hist = np.zeros(4096, np.int32)
-        base_moves, count, noisy = gen(from_position(pos), jnp.asarray(hist))
-        hist[mv & 4095] = 1 << 16
-        moves, count, noisy = gen(from_position(pos), jnp.asarray(hist))
+        # two INDEPENDENT buffers: jnp.asarray of a numpy array can be
+        # zero-copy on CPU and dispatch is async, so mutating the base
+        # buffer in place raced the base computation (seen under full
+        # suite load: the base run read the already-bumped table)
+        hist0 = np.zeros(4096, np.int32)
+        hist1 = np.zeros(4096, np.int32)
+        hist1[mv & 4095] = 1 << 16
+        base_moves, count, noisy = gen(from_position(pos), jnp.asarray(hist0))
+        moves, count, noisy = gen(from_position(pos), jnp.asarray(hist1))
         moves = np.asarray(moves)[: int(count)].tolist()
         quiet_tail = moves[int(noisy):]
         # castling (key 900) sorts before history-bumped quiets (911+),

@@ -296,3 +296,38 @@ def test_resumable_deadline_stops_early(params):
     )
     assert int(out["steps"]) <= 100  # stopped after the first segment
     assert not bool(np.asarray(out["done"])[0])
+
+
+@pytest.mark.slow
+def test_narrowing_matches_unnarrowed(params):
+    """Lane narrowing (search_batch_resumable narrow=True) must be
+    invisible in the results: retiring finished lanes into half-width
+    programs relocates lanes but never changes any lane's search. A
+    B=256 batch whose lanes finish in strongly uneven cohorts (tiny
+    endgames vs a dense middlegame) with tiny segments forces REPEATED
+    narrows (256 → 128 → 64), covering the twice-remapped `orig` /
+    invalid-pad bookkeeping, not just a single halving."""
+    if not nnue.is_board768(params):
+        pytest.skip("one feature set is enough")
+    from fishnet_tpu.ops.search import search_batch_resumable
+
+    fens = [
+        "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",  # tiny tree: finishes early
+        "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
+        "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
+        "r3k2r/p1ppqpb1/bn2pnp1/3PN3/1p2P3/2N2Q1p/PPPBBPPP/R3K2R w KQkq - 0 1",
+    ]
+    boards = [from_position(Position.from_fen(f)) for f in fens]
+    roots = stack_boards([boards[i % len(boards)] for i in range(256)])
+    outs = {}
+    for narrow in (False, True):
+        out = search_batch_resumable(
+            params, roots, 2, 20_000, max_ply=4, segment_steps=48,
+            narrow=narrow,
+        )
+        out.pop("tt")
+        outs[narrow] = {k: np.asarray(v) for k, v in out.items()}
+    for k in ("score", "move", "nodes", "pv_len", "done"):
+        assert (outs[False][k] == outs[True][k]).all(), k
+    assert (outs[False]["pv"] == outs[True]["pv"]).all()
+    assert outs[True]["done"].all()
