@@ -191,8 +191,11 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # pre-compile every narrowed width down to the floor: the warmup and
     # timed runs can take DIFFERENT narrowing trajectories (a warm TT
     # changes when lanes finish), and a cold 10-40 s XLA compile landing
-    # inside the timed region would corrupt the recorded nps
-    w = B // 2
+    # inside the timed region would corrupt the recorded nps. Narrowing
+    # targets are powers of two >= 64 (ops/search.py), regardless of B.
+    w = 64
+    while w * 2 < B:
+        w *= 2
     while w >= 64:
         sub = jax.tree.map(lambda a: a[:w], state)
         _hb(t0, f"compile_start run_segment(width={w})")
@@ -239,6 +242,9 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                     else "select"
                 ),
                 "max_ply": max_ply,
+                "positions_done_per_s": round(
+                    float(np.asarray(out["done"]).sum()) / dt, 1
+                ),
                 "net": os.environ.get("BENCH_NET", "random"),
                 "dtype": bench_dtype or "f32",
                 "tt_log2": tt_log2,
@@ -390,7 +396,13 @@ def main() -> None:
              {"BENCH_DTYPE": "bf16"}),
             ("dtype_int8", 64, 3, "standard", "standard",
              {"BENCH_DTYPE": "int8"}),
-            ("production_d6_mp32", 64, 6, "standard", "standard",
+            # multipv fen_set: DISTINCT positions per lane — repeating the
+            # 8 standard FENs across lanes lets the shared TT dedup whole
+            # subtrees, which deflates the nodes/sec metric while doing
+            # the same per-position work (round-5 measurement note).
+            # B=192: the 8 FENs decompose into 229 root-move boards, so
+            # 192 is the largest stage width with no duplicate padding
+            ("production_d6_mp32", 192, 6, "standard", "multipv",
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
               "BENCH_TT_LOG2": "21"}),
         ]

@@ -1055,9 +1055,14 @@ def search_batch_resumable(
         if narrow and mesh is None and cur > 64:
             done = np.asarray(state.lane[:, LN_MODE] == MODE_DONE)
             live = int((~done & valid).sum())
-            new_b = cur
-            while new_b > 64 and live <= new_b // 2:
-                new_b //= 2
+            # target width: smallest power of two >= live, floor 64 —
+            # always a power of two even when the caller's width is not
+            # (the engine pads >256-lane batches to multiples of 256),
+            # so narrowed programs land on the handful of pow2 shapes
+            # the compile cache / engine warmup already know
+            new_b = 64
+            while new_b < live:
+                new_b *= 2
             if new_b < cur:
                 _flush(extract_results(state, jnp.int32(total)),
                        done & valid)

@@ -7,17 +7,18 @@ search lane on the chip: entries live in HBM arrays carried through the
 search while_loop, probed/stored with batched gathers/scatters.
 
 Race tolerance (SURVEY.md §7.3 "lock-free XOR trick"): a batched scatter
-with colliding indices may interleave lanes arbitrarily, and the two
-entry words are written by *separate* scatters, so an entry can be torn
-(lane A's key word with lane B's data word). Every entry therefore
-stores `check = hash2 ^ meta ^ move`; a probe recomputes the XOR and a
-torn entry simply fails validation and reads as a miss — stale or
-corrupt entries can never return a wrong score, only cost a re-search.
+with colliding indices may interleave lanes arbitrarily per ELEMENT, so
+an entry row can be torn (lane A's key word with lane B's data word).
+Every entry therefore stores `check = hash2 ^ meta ^ move`; a probe
+recomputes the XOR and a torn entry simply fails validation and reads as
+a miss — stale or corrupt entries can never return a wrong score, only
+cost a re-search.
 
-Entry layout (3 × int32 words per slot, SoA):
-    check: hash2 ^ meta ^ move        (validation word)
-    meta:  (score+32768) << 10 | searched_depth << 2 | flag
-    move:  the node's best move encoding (-1 when none)
+Entry layout (one packed (4,) int32 row per slot — see TTable):
+    [0] check: hash2 ^ meta ^ move    (validation word, uint32 bits)
+    [1] meta:  (score+32768) << 10 | searched_depth << 2 | flag
+    [2] move:  the node's best move encoding (-1 when none)
+    [3] pad
 Mate-range scores are never stored (ply-relative mate distances don't
 transpose; skipping them keeps the table sound without ply adjustment).
 """
@@ -70,23 +71,35 @@ def hash_boards(boards, variant: str = "standard"):
 
 
 class TTable(NamedTuple):
-    check: jnp.ndarray  # (N,) uint32
-    meta: jnp.ndarray  # (N,) int32
-    move: jnp.ndarray  # (N,) int32
+    """Packed entry rows: data[..., 0]=check (uint32 bits), 1=meta,
+    2=move, 3=pad. One (N, 4) array instead of three (N,) arrays so a
+    probe is ONE row gather and a store ONE row scatter — the round-5
+    device profile showed each extra big-table gather/scatter costing
+    tens of us/step, and the split layout paid 3 gathers + 6 scatters
+    per step. (Pad to 4: power-of-two rows tile cleanly.)"""
+    data: jnp.ndarray  # (..., N, 4) int32
+
+    @property
+    def check(self) -> jnp.ndarray:  # uint32 view
+        return jax.lax.bitcast_convert_type(self.data[..., 0], jnp.uint32)
+
+    @property
+    def meta(self) -> jnp.ndarray:
+        return self.data[..., 1]
+
+    @property
+    def move(self) -> jnp.ndarray:
+        return self.data[..., 2]
 
     @property
     def size(self) -> int:
-        return self.check.shape[0]
+        return self.data.shape[-2]
 
 
 def make_table(size_log2: int = 20) -> TTable:
-    """2**size_log2 slots × 12 bytes (default 2^20 ≈ 12 MiB HBM)."""
+    """2**size_log2 slots × 16 bytes (default 2^20 = 16 MiB HBM)."""
     n = 1 << size_log2
-    return TTable(
-        check=jnp.zeros((n,), jnp.uint32),
-        meta=jnp.zeros((n,), jnp.int32),
-        move=jnp.zeros((n,), jnp.int32),
-    )
+    return TTable(data=jnp.zeros((n, 4), jnp.int32))
 
 
 def hash_board(board64, stm, ep, castling, extra=None, variant: str = "standard"):
@@ -179,9 +192,11 @@ def probe(tt: TTable, h1, h2, depth_left, alpha, beta,
     searched — move jobs opt in for strength; analysis keeps the exact
     rule below for deterministic scores."""
     slot = (h1 & jnp.uint32(tt.size - 1)).astype(jnp.int32)
-    meta = tt.meta[slot]
-    move = tt.move[slot]
-    valid = (tt.check[slot] ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)) == h2
+    rows = tt.data[slot]  # (..., 4): ONE gather for check+meta+move
+    check = jax.lax.bitcast_convert_type(rows[..., 0], jnp.uint32)
+    meta = rows[..., 1]
+    move = rows[..., 2]
+    valid = (check ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)) == h2
     valid &= meta != 0
     score, depth, flag = unpack_meta(meta)
     # EXACT depth match, not >=: an entry stored at depth d is a bound on
@@ -222,8 +237,13 @@ def store(tt: TTable, h1, h2, score, depth, flag, move, mask):
     slot = jnp.where(storable, slot, tt.size)  # out-of-range → dropped
     meta = pack_meta(score, depth, flag)
     check = h2 ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)
-    return TTable(
-        check=tt.check.at[slot].set(check, mode="drop"),
-        meta=tt.meta.at[slot].set(meta, mode="drop"),
-        move=tt.move.at[slot].set(move, mode="drop"),
+    rows = jnp.stack(
+        [
+            jax.lax.bitcast_convert_type(check, jnp.int32),
+            meta, move, jnp.zeros_like(meta),
+        ],
+        axis=-1,
     )
+    # ONE row scatter; colliding lanes may still interleave per element
+    # (rows can tear) — exactly the race the XOR check word tolerates
+    return TTable(data=tt.data.at[slot].set(rows, mode="drop"))

@@ -104,9 +104,7 @@ def make_sharded_table(mesh: Mesh, size_log2: int):
     import jax.numpy as jnp
 
     t = tt_mod.TTable(
-        check=jnp.zeros((n, base.size), jnp.uint32),
-        meta=jnp.zeros((n, base.size), jnp.int32),
-        move=jnp.zeros((n, base.size), jnp.int32),
+        data=jnp.zeros((n, base.size, 4), jnp.int32),
     )
     return shard_batch(mesh, t)
 

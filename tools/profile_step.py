@@ -27,6 +27,7 @@ def main() -> None:
     depth = int(args[1]) if len(args) > 1 else 3
     max_ply = int(args[2]) if len(args) > 2 else depth + 1
     do_trace = "--trace" in sys.argv
+    use_tt = "--tt" in sys.argv  # shared 2^21-slot table (production config)
     steps = int(os.environ.get("PROFILE_STEPS", "200"))
 
     import jax
@@ -52,9 +53,14 @@ def main() -> None:
     state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply,
                               "standard")
     jax.block_until_ready(state.bt)
+    tt0 = None
+    if use_tt:
+        from fishnet_tpu.ops import tt as tt_mod
+
+        tt0 = tt_mod.make_table(21)
 
     t0 = time.perf_counter()
-    S._run_segment_jit.lower(params, state, None, steps, "standard",
+    S._run_segment_jit.lower(params, state, tt0, steps, "standard",
                              False).compile()
     print(f"compile run_segment({steps}): {time.perf_counter() - t0:.1f}s",
           file=sys.stderr)
@@ -62,7 +68,7 @@ def main() -> None:
     # warmup + timed: same fresh state each time so step counts match
     for tag in ("warmup", "timed1", "timed2", "timed3"):
         t0 = time.perf_counter()
-        out, _, n = S._run_segment_jit(params, state, None, steps, "standard",
+        out, _, n = S._run_segment_jit(params, state, tt0, steps, "standard",
                                        False)
         jax.block_until_ready(out.lane)
         dt = time.perf_counter() - t0
@@ -76,7 +82,7 @@ def main() -> None:
 
     trace_dir = os.environ.get("PROFILE_TRACE_DIR", "/tmp/fishnet-trace")
     with jax.profiler.trace(trace_dir):
-        out, _, n = S._run_segment_jit(params, state, None, steps, "standard",
+        out, _, n = S._run_segment_jit(params, state, tt0, steps, "standard",
                                        False)
         jax.block_until_ready(out.lane)
     print(f"trace written to {trace_dir}", file=sys.stderr)
