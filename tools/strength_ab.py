@@ -23,7 +23,6 @@ import random
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main() -> int:
@@ -48,9 +47,14 @@ def main() -> int:
                     help="same, for the opponent side (requires "
                          "--opponent-net for net-vs-net, or uses the "
                          "same net)")
+    ap.add_argument("--device", action="store_true",
+                    help="run on the real accelerator (default: force "
+                         "CPU, the tool's historical mode — device runs "
+                         "are ~50x faster per cycle)")
     args = ap.parse_args()
 
-    from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
+    if not args.device:
+        from tools import force_cpu  # noqa: F401  (deregisters axon)
     import numpy as np
 
     from fishnet_tpu.utils import enable_compile_cache
@@ -61,7 +65,6 @@ def main() -> int:
     from fishnet_tpu.engine.pyengine import MATE_VALUE, PySearch
     from fishnet_tpu.models import nnue
     from fishnet_tpu.ops.board import from_position, stack_boards
-    from fishnet_tpu.ops.search import search_batch_jit
 
     params = nnue.load_params(args.net)
     rng = random.Random(args.seed)
@@ -77,18 +80,37 @@ def main() -> int:
 
     PAD = 16  # lane bucket granularity: few distinct compiled shapes
 
-    def device_moves(positions, p=None, depth=None):
+    # ONE lane shape for the whole match: per-cycle batch sizes shrink as
+    # games finish, and every distinct width is a fresh XLA compile (plus
+    # the round-5 narrowing path would compile its own widths per shape —
+    # a first run of this tool spent ~an hour compiling instead of
+    # playing). Dead lanes re-search boards[0]; a lockstep step costs the
+    # same either way, so uniform width trades no real time for one
+    # compile per (depth, max_ply).
+    from fishnet_tpu.ops.search import search_batch_resumable
+    from fishnet_tpu.ops import tt as tt_mod
+
+    B0 = ((args.games + PAD - 1) // PAD) * PAD
+    # one persistent TT per side, carried across move cycles (the engine
+    # keeps one per process too): without it every move re-searches its
+    # whole tree and a 160-game match costs ~an hour of device time
+    side_tt = {}
+
+    def device_moves(positions, p=None, depth=None, side="net"):
         """One batched dispatch: best move per position (None on fail)."""
         if not positions:
             return []
         p = params if p is None else p
         depth = args.depth if depth is None else depth
         boards = [from_position(pos) for pos in positions]
-        B = ((len(boards) + PAD - 1) // PAD) * PAD
-        roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
-        out = search_batch_jit(
-            p, roots, depth, 500_000, max_ply=depth + 3
+        roots = stack_boards(boards + [boards[0]] * (B0 - len(boards)))
+        if side not in side_tt:
+            side_tt[side] = tt_mod.make_table(21)
+        out = search_batch_resumable(
+            p, roots, depth, 500_000, max_ply=depth + 3, narrow=False,
+            tt=side_tt[side],
         )
+        side_tt[side] = out.pop("tt")
         ms = np.asarray(out["move"])[: len(boards)]
         return [decode_uci(int(m)) if int(m) >= 0 else None for m in ms]
 
@@ -112,13 +134,22 @@ def main() -> int:
             for m in legal:
                 lane_pos.append(gi)
                 boards.append(from_position(pos.push(m)))
-        # coarse 256-lane buckets: root-move lane counts vary every
-        # cycle, and each distinct shape is a fresh XLA compile
-        B = ((len(boards) + 255) // 256) * 256
+        # power-of-two buckets (floor 256): root-move lane counts vary
+        # every cycle and each distinct width is a fresh XLA compile, so
+        # coarse pow2 padding keeps it to 1-2 programs per match; same
+        # narrow=False + per-side persistent TT as device_moves
+        B = 256
+        while B < len(boards):
+            B *= 2
         roots = stack_boards(boards + [boards[0]] * (B - len(boards)))
-        out = search_batch_jit(
-            p, roots, max(depth - 1, 0), 500_000, max_ply=depth + 3
+        skey = f"skill-{tag[:3]}-{B}"
+        if skey not in side_tt:
+            side_tt[skey] = tt_mod.make_table(21)
+        out = search_batch_resumable(
+            p, roots, max(depth - 1, 0), 500_000, max_ply=depth + 3,
+            narrow=False, tt=side_tt[skey],
         )
+        side_tt[skey] = out.pop("tt")
         scores = np.asarray(out["score"])
         picks = []
         k = 0
@@ -198,7 +229,7 @@ def main() -> int:
         else:
             opp_ucis = device_moves(
                 [g["pos"] for g in opp_turn], p=opp_params,
-                depth=args.py_depth,
+                depth=args.py_depth, side="opp",
             )
         for g, uci in zip(opp_turn, opp_ucis):
             if uci is None:
@@ -217,7 +248,7 @@ def main() -> int:
                 [g["pos"] for g in net_turn], args.skill, tag=f"net{cycle}",
             )
         else:
-            ucis = device_moves([g["pos"] for g in net_turn])
+            ucis = device_moves([g["pos"] for g in net_turn], side="net")
         for g, uci in zip(net_turn, ucis):
             if uci is None:
                 settle(g, None)
