@@ -113,7 +113,7 @@ def random_position_dataset(n: int, seed: int = 0, max_plies: int = 60):
     import random as _random
 
     from ..chess import Position
-    from ..ops.board import from_position
+    from ..ops.board import board_array
 
     rng = _random.Random(seed)
     boards = np.zeros((n, 64), np.int32)
@@ -129,9 +129,8 @@ def random_position_dataset(n: int, seed: int = 0, max_plies: int = 60):
             legal = pos.legal_moves()
         pos = pos.push(rng.choice(legal))
         plies += 1
-        b = from_position(pos)
-        boards[i] = np.asarray(b.board)
-        stms[i] = int(b.stm)
+        boards[i] = board_array(pos)  # numpy: no per-position device put
+        stms[i] = int(pos.turn)
         targets[i] = material_mobility_target(pos)
     return boards, stms, targets
 
@@ -307,7 +306,7 @@ def diverse_position_dataset(n: int, seed: int = 0):
     import random as _random
 
     from ..chess import Position
-    from ..ops.board import from_position
+    from ..ops.board import board_array
 
     rng = _random.Random(seed)
     boards = np.zeros((n, 64), np.int32)
@@ -330,9 +329,11 @@ def diverse_position_dataset(n: int, seed: int = 0):
             sample = _random_material_position(rng)
             if sample is None or sample.outcome() is not None:
                 continue
-        b = from_position(sample)
-        boards[i] = np.asarray(b.board)
-        stms[i] = int(b.stm)
+        # numpy end to end: per-position jnp conversion costs a device
+        # put (through the remote tunnel, ~ms each) — at 200k positions
+        # the round-5 run spent 30+ min "generating" before the fix
+        boards[i] = board_array(sample)
+        stms[i] = int(sample.turn)
         targets[i] = classical_eval_target(sample)
         i += 1
     return boards, stms, targets

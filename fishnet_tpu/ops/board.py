@@ -46,13 +46,21 @@ EXTRA_POCKET = 0  # +color*5 + ptype
 EXTRA_PROMOTED = 10  # +word
 
 
-def from_position(pos: Position) -> Board:
-    """Host Position → single-lane Board (numpy)."""
+def board_array(pos: Position) -> np.ndarray:
+    """Host Position → (64,) numpy piece-code array (no device traffic —
+    dataset builders iterate millions of positions and a per-position
+    device put through the remote-TPU tunnel costs ~ms each)."""
     board = np.zeros(64, dtype=np.int32)
     for color in (0, 1):
         for ptype in range(6):
             for sq in scan(pos.bbs[color][ptype]):
                 board[sq] = 1 + ptype + 6 * color
+    return board
+
+
+def from_position(pos: Position) -> Board:
+    """Host Position → single-lane Board (numpy)."""
+    board = board_array(pos)
     castling = np.full(4, -1, dtype=np.int32)
     # variants without castling (antichess, racingKings) never carry
     # rights on device — the host parses-but-ignores any FEN rights

@@ -40,9 +40,26 @@ def main() -> int:
     ap.add_argument("--warm-start", action="store_true",
                     help="initialize from --base instead of fresh")
     ap.add_argument("--out", default="/tmp/net-search-distilled.npz")
+    ap.add_argument("--device", action="store_true",
+                    help="label AND train on the real accelerator "
+                         "(default: force CPU, the historical mode)")
+    ap.add_argument("--classical-mix", type=float, default=0.25,
+                    help="regularizer weight L: train against "
+                         "(search + L*classical)/(1+L) — for MSE this "
+                         "IS the sum-of-losses regularizer (identical "
+                         "gradients up to scale); docs/strength.md "
+                         "recipe (b) against label-noise memorization")
+    ap.add_argument("--holdout", type=float, default=0.05,
+                    help="fraction of labels held out; training stops "
+                         "when held-out loss stops improving "
+                         "(docs/strength.md recipe (c))")
+    ap.add_argument("--patience", type=int, default=6,
+                    help="early-stop after this many 250-step windows "
+                         "without a held-out improvement")
     args = ap.parse_args()
 
-    from tools import force_cpu  # noqa: F401  (deregisters the axon plugin)
+    if not args.device:
+        from tools import force_cpu  # noqa: F401  (deregisters axon)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -61,7 +78,9 @@ def main() -> int:
     base = nnue.load_params(args.base)
 
     print(f"generating {args.samples} positions ...", flush=True)
-    boards, stms, _ = diverse_position_dataset(args.samples, seed=args.seed)
+    boards, stms, classical = diverse_position_dataset(
+        args.samples, seed=args.seed
+    )
 
     print(f"labeling with depth-{args.depth} search of the base net ...",
           flush=True)
@@ -82,10 +101,18 @@ def main() -> int:
             halfmove=jnp.zeros((B,), jnp.int32),
             extra=jnp.zeros((B, 12), jnp.int32),
         )
+        # max_steps caps the worst batch: random-material monsters (200+
+        # moves/node) can spend millions of lockstep steps unwinding
+        # after budget exhaustion (a 200k-label run stalled ~40 min on
+        # one such batch); lanes cut off report done=False and fall back
+        # to their classical target below — sane labels either way
         out = search_batch_jit(
-            base, roots, args.depth, args.budget, max_ply=args.depth + 2
+            base, roots, args.depth, args.budget, max_ply=args.depth + 2,
+            max_steps=250_000,
         )
         sc = np.asarray(out["score"])[:n].astype(np.float32)
+        ok = np.asarray(out["done"])[:n]
+        sc = np.where(ok, sc, classical[sl].astype(np.float32))
         # mate-range backups would dominate the regression loss; clamp to
         # the same range the eval itself lives in
         labels[sl] = np.clip(sc, -3000, 3000)
@@ -94,7 +121,26 @@ def main() -> int:
             rate = done / max(time.time() - t0, 1e-9)
             print(f"  {done}/{args.samples} ({rate:,.0f} pos/s)", flush=True)
 
-    print("training ...", flush=True)
+    # recipe (b): classical-target regularizer via label blending — for
+    # MSE, min over p of (p-s)^2 + L*(p-c)^2 has the same gradients as
+    # (1+L) * (p - (s+L*c)/(1+L))^2, so blending IS the regularizer
+    lam = args.classical_mix
+    labels = (labels + lam * classical.astype(np.float32)) / (1.0 + lam)
+
+    # recipe (c): held-out split, early stop on held-out loss (cap so a
+    # tiny --samples smoke run keeps a non-empty training split)
+    n_hold = min(
+        max(int(args.samples * args.holdout), args.batch),
+        args.samples // 2,
+    )
+    rng = np.random.default_rng(args.seed)
+    perm = rng.permutation(args.samples)
+    hold, tr = perm[:n_hold], perm[n_hold:]
+    hb, hs, hl = (jnp.asarray(boards[hold]), jnp.asarray(stms[hold]),
+                  jnp.asarray(labels[hold]))
+
+    print(f"training ({len(tr)} train / {n_hold} held out, "
+          f"classical mix {lam}) ...", flush=True)
     if args.warm_start:
         params = base
     else:
@@ -108,19 +154,38 @@ def main() -> int:
     )
     opt_state = optimizer.init(params)
     step = make_train_step(optimizer)
-    rng = np.random.default_rng(args.seed)
+    from fishnet_tpu.models.train import loss_fn
+
+    val_loss = jax.jit(loss_fn)
     loss = None
+    best = (float("inf"), params, -1)
+    stale = 0
     for i in range(args.steps):
-        idx = rng.integers(0, args.samples, size=args.batch)
+        idx = tr[rng.integers(0, len(tr), size=args.batch)]
         params, opt_state, loss = step(
             params, opt_state,
             jnp.asarray(boards[idx]), jnp.asarray(stms[idx]),
             jnp.asarray(labels[idx]),
         )
-        if i % 500 == 0:
-            print(f"  step {i}: loss {float(loss):.4f}", flush=True)
+        if i % 250 == 0:
+            v = float(val_loss(params, hb, hs, hl))
+            mark = ""
+            if v < best[0] - 1e-4:
+                best = (v, params, i)
+                stale = 0
+                mark = " *"
+            else:
+                stale += 1
+            print(f"  step {i}: loss {float(loss):.4f} "
+                  f"held-out {v:.4f}{mark}", flush=True)
+            if stale >= args.patience:
+                print(f"  early stop at step {i} (best held-out "
+                      f"{best[0]:.4f} @ step {best[2]})", flush=True)
+                break
+    params = best[1]
     nnue.save_params(params, args.out)
-    print(f"saved {args.out} (final loss {float(loss):.4f})")
+    print(f"saved {args.out} (best held-out loss {best[0]:.4f} "
+          f"@ step {best[2]})")
     return 0
 
 
