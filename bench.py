@@ -42,7 +42,11 @@ SELECT_FIRST = os.environ.get("BENCH_SELECT_FIRST", "1") != "0"
 
 
 def _hb(t0: float, msg: str) -> None:
-    print(f"[bench {time.time() - t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    # shared phase-heartbeat formatter: the engine supervisor's child host
+    # (engine/host.py) emits the same scheme over its pipe protocol
+    from fishnet_tpu.utils.heartbeat import stamp
+
+    stamp(t0, msg, tag="bench")
 
 
 # BASELINE.md benchmark-config position sets

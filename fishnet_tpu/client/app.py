@@ -54,13 +54,32 @@ def make_engine_factory(cfg: Config, logger: Logger):
     def factory(flavor: EngineFlavor):
         nonlocal tpu_engine
         if flavor is EngineFlavor.TPU:
-            from ..engine.tpu import TpuEngine
-
             if tpu_engine is None:
-                tpu_engine = TpuEngine(
-                    weights_path=cfg.tpu_weights, max_depth=cfg.tpu_depth
-                )
-            return tpu_engine  # one device program shared by all workers
+                if cfg.supervisor:
+                    # device work runs in a killable child process behind
+                    # the supervisor proxy (engine/supervisor.py): a wedged
+                    # device gets SIGKILLed and respawned instead of
+                    # wedging this process's executor threads forever
+                    from ..engine.supervisor import SupervisedEngine
+
+                    tpu_engine = SupervisedEngine(
+                        backend="tpu",
+                        weights_path=cfg.tpu_weights,
+                        max_depth=cfg.tpu_depth,
+                        logger=logger,
+                    )
+                else:
+                    from ..engine.tpu import TpuEngine
+
+                    tpu_engine = TpuEngine(
+                        weights_path=cfg.tpu_weights,
+                        max_depth=cfg.tpu_depth,
+                        logger=logger,
+                    )
+            # one device program (or supervised child) shared by all
+            # workers; workers close() it on drop — SupervisedEngine
+            # stays reusable across close(), preserving breaker state
+            return tpu_engine
         if cfg.backend == "subprocess" or cfg.engine_path or cfg.variant_engine_path:
             from ..engine.uci import UciEngine
 
@@ -158,15 +177,22 @@ async def run(cfg: Config) -> int:
         for attempt in range(3):
             try:
                 engine = factory(EngineFlavor.TPU)
-                await asyncio.to_thread(engine.warmup, None, logger.info)
-                logger.info("TPU engine ready (all lane buckets compiled).")
-                # variant programs compile in the background; dispatches
-                # interleave behind the engine lock, so standard chunks
-                # flow immediately while variant chunks stop racing
-                # their deadlines within the first few minutes
-                asyncio.ensure_future(
-                    asyncio.to_thread(engine.warmup_variants, logger.info)
-                )
+                if cfg.supervisor:
+                    # the child owns the device: its warmup (and the
+                    # background variant compiles, engine/host.py) runs
+                    # under heartbeat watch rather than a fixed timeout
+                    await engine.start()
+                    logger.info("Supervised TPU engine host ready.")
+                else:
+                    await asyncio.to_thread(engine.warmup, None, logger.info)
+                    logger.info("TPU engine ready (all lane buckets compiled).")
+                    # variant programs compile in the background; dispatches
+                    # interleave behind the engine lock, so standard chunks
+                    # flow immediately while variant chunks stop racing
+                    # their deadlines within the first few minutes
+                    asyncio.ensure_future(
+                        asyncio.to_thread(engine.warmup_variants, logger.info)
+                    )
                 break
             except Exception as e:
                 logger.warn(f"TPU warmup attempt {attempt + 1} failed: {e}")

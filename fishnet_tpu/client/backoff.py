@@ -22,3 +22,8 @@ class RandomizedBackoff:
 
     def reset(self) -> None:
         self._last_ms = 0
+
+    def pending(self) -> bool:
+        """True when the previous cycle failed, i.e. the next (re)start
+        should be delayed by `next()` rather than immediate."""
+        return self._last_ms > 0

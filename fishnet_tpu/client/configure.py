@@ -92,6 +92,10 @@ class Config:
     # while the deadline race still cuts off any iteration a slow
     # backend can't afford.
     tpu_depth: int = 12
+    # host the TPU engine in a supervised child process (engine/supervisor.py)
+    # so a wedged device can be hard-killed and respawned; --no-supervisor
+    # reverts to the in-process engine (debugging, single-process profiling)
+    supervisor: bool = True
     user_backlog: Optional[float] = None
     system_backlog: Optional[float] = None
     max_backoff: float = 30.0
@@ -134,6 +138,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu-weights",
                    help="NNUE weights: our .npz or a Stockfish .nnue file")
     p.add_argument("--tpu-depth", type=int, help="max search depth for the TPU engine")
+    p.add_argument("--no-supervisor", action="store_true",
+                   help="run the TPU engine in-process instead of in a "
+                        "supervised child process")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")
     p.add_argument("--max-backoff", help="maximum backoff duration")
@@ -196,6 +203,10 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.variant_engine_path = pick(args.variant_engine_path, "variant_engine_path")
     cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
     cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", Config.tpu_depth))
+    supervisor_ini = str(ini.get("supervisor", "")).strip().lower()
+    cfg.supervisor = not (
+        args.no_supervisor or supervisor_ini in ("0", "false", "no", "off")
+    )
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

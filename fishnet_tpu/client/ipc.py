@@ -109,6 +109,105 @@ class PositionResponse:
         )
 
 
+# -------------------------------------------------------- pipe-wire serde
+#
+# JSON-dict conversion for Chunk and PositionResponse, used by the
+# supervisor↔host pipe protocol (engine/supervisor.py, engine/host.py).
+# Deadlines are time.monotonic() timestamps, which do NOT transfer across
+# processes — the wire form carries remaining seconds ("ttl") and each side
+# re-anchors against its own clock.
+
+
+def _matrix_to_wire(matrix: Matrix, cell) -> list:
+    return [[None if v is None else cell(v) for v in row] for row in matrix.matrix]
+
+
+def _matrix_from_wire(rows: list, cell) -> Matrix:
+    m = Matrix()
+    m.matrix = [[None if v is None else cell(v) for v in row] for row in rows]
+    return m
+
+
+def chunk_to_wire(chunk: Chunk) -> dict:
+    import time
+
+    from .wire import work_to_json
+
+    return {
+        "work": work_to_json(chunk.work),
+        "ttl": chunk.deadline - time.monotonic(),
+        "variant": chunk.variant,
+        "flavor": chunk.flavor.value,
+        "positions": [
+            {
+                "position_index": wp.position_index,
+                "url": wp.url,
+                "skip": wp.skip,
+                "root_fen": wp.root_fen,
+                "moves": wp.moves,
+            }
+            for wp in chunk.positions
+        ],
+    }
+
+
+def chunk_from_wire(obj: dict) -> Chunk:
+    import time
+
+    from .wire import work_from_json
+
+    work = work_from_json(obj["work"])
+    return Chunk(
+        work=work,
+        deadline=time.monotonic() + float(obj["ttl"]),
+        variant=obj["variant"],
+        flavor=EngineFlavor(obj["flavor"]),
+        positions=[
+            WorkPosition(
+                work=work,
+                position_index=p["position_index"],
+                url=p["url"],
+                skip=p["skip"],
+                root_fen=p["root_fen"],
+                moves=list(p["moves"]),
+            )
+            for p in obj["positions"]
+        ],
+    )
+
+
+def response_to_wire(res: PositionResponse) -> dict:
+    return {
+        "position_index": res.position_index,
+        "url": res.url,
+        "scores": _matrix_to_wire(res.scores, lambda s: s.to_json()),
+        "pvs": _matrix_to_wire(res.pvs, list),
+        "best_move": res.best_move,
+        "depth": res.depth,
+        "nodes": res.nodes,
+        "time_s": res.time_s,
+        "nps": res.nps,
+    }
+
+
+def responses_from_wire(work: Work, objs: List[dict]) -> List[PositionResponse]:
+    return [
+        PositionResponse(
+            work=work,
+            position_index=o["position_index"],
+            url=o["url"],
+            scores=_matrix_from_wire(o["scores"], Score.from_json),
+            pvs=_matrix_from_wire(o["pvs"], list),
+            best_move=o["best_move"],
+            depth=int(o["depth"]),
+            nodes=int(o["nodes"]),
+            time_s=float(o["time_s"]),
+            nps=int(o["nps"]) if o.get("nps") is not None else None,
+        )
+        for o in objs
+    ]
+
+
 class ChunkFailed(Exception):
     """Engine-side failure; the batch is forgotten so the server re-queues it
     by timeout (reference: src/queue.rs:226-233)."""

@@ -165,6 +165,33 @@ class MoveWork:
 Work = Union[AnalysisWork, MoveWork]
 
 
+def work_to_json(work: "Work") -> dict:
+    """Inverse of work_from_json (same shapes the server sends) — used by
+    the supervisor↔host pipe protocol to ship chunks across the process
+    boundary (engine/supervisor.py)."""
+    if isinstance(work, AnalysisWork):
+        out: dict = {
+            "type": "analysis",
+            "id": work.id,
+            "nodes": {"sf16": work.nodes.sf16, "classical": work.nodes.classical},
+            "timeout": int(work.timeout_s * 1000),
+        }
+        if work.depth is not None:
+            out["depth"] = work.depth
+        if work.multipv is not None:
+            out["multipv"] = work.multipv
+        return out
+    assert isinstance(work, MoveWork)
+    out = {"type": "move", "id": work.id, "level": work.level.level}
+    if work.clock is not None:
+        out["clock"] = {
+            "wtime": work.clock.wtime_centis,
+            "btime": work.clock.btime_centis,
+            "inc": work.clock.inc_seconds,
+        }
+    return out
+
+
 def work_from_json(obj: dict) -> Work:
     batch_id = str(obj["id"])
     if len(batch_id) > 24:
@@ -239,6 +266,14 @@ class Score:
     @staticmethod
     def mate(value: int) -> "Score":
         return Score("mate", value)
+
+    @staticmethod
+    def from_json(obj: dict) -> "Score":
+        if "cp" in obj:
+            return Score.cp(int(obj["cp"]))
+        if "mate" in obj:
+            return Score.mate(int(obj["mate"]))
+        raise ValueError(f"score is neither cp nor mate: {obj!r}")
 
 
 @dataclass

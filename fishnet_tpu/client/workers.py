@@ -41,7 +41,7 @@ async def worker(
             engine = engines.get(flavor)
             if engine is None:
                 backoff = backoffs.setdefault(flavor, RandomizedBackoff())
-                if backoff._last_ms:
+                if backoff.pending():
                     delay = backoff.next()
                     logger.warn(
                         f"Worker {index} waiting {delay:.1f}s before restarting"
@@ -66,7 +66,7 @@ async def worker(
                 responses = await asyncio.wait_for(
                     engine.go_multiple(chunk), timeout=timeout
                 )
-                backoffs.get(flavor, RandomizedBackoff()).reset()
+                backoffs.setdefault(flavor, RandomizedBackoff()).reset()
             except asyncio.TimeoutError:
                 logger.warn(
                     f"Worker {index} chunk of batch {chunk.work.id} timed out;"

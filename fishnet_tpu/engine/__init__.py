@@ -1,4 +1,14 @@
-"""Engine backends: TPU (JAX/XLA), UCI subprocess, and pure-Python CPU."""
+"""Engine backends: TPU (JAX/XLA), UCI subprocess, and pure-Python CPU,
+plus the process-isolation supervisor that hosts any of them in a
+killable child process."""
 from .base import Engine, EngineError, EngineFactory
+from .supervisor import SupervisedEngine, SupervisorStats, default_host_cmd
 
-__all__ = ["Engine", "EngineError", "EngineFactory"]
+__all__ = [
+    "Engine",
+    "EngineError",
+    "EngineFactory",
+    "SupervisedEngine",
+    "SupervisorStats",
+    "default_host_cmd",
+]

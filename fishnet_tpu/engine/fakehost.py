@@ -1,0 +1,209 @@
+"""Scriptable fake engine host: deterministic fault injection.
+
+Speaks the exact supervisor↔host protocol of engine/host.py but executes
+a *fault script* instead of a real engine, so every supervisor path —
+heartbeat-stall kill, deadline kill, crash respawn, corrupt-frame kill,
+circuit-breaker trip and probe recovery — is exercisable in tier-1 on
+CPU with no JAX import at all. tools/chaos.py replays the same scripts
+against a live supervisor for manual soak testing.
+
+A script is a JSON object:
+
+    {"boot":   ["ready", "crash:3", "stall", "slow:2.0", ...],
+     "chunks": ["ok", "hang", "stall", "crash:9", "corrupt",
+                "slow:1.5", "err", "ok:333", ...]}
+
+`boot[i]` is the startup behavior of the i-th host incarnation;
+`chunks[j]` the behavior for the j-th chunk EVER dispatched (counted
+across respawns). Lists are extended by repeating their last entry. The
+cross-incarnation counters persist in --state (a JSON file) — without
+it, every respawn would replay the script from the top and a
+crash-then-recover sequence could never be expressed.
+
+Actions:
+    ready       boot only: warm up instantly and send ready
+    ok[:CP]     reply with a depth-1 response per position, score cp CP
+                (default 777 — a signature tests use to tell the fake
+                host's responses from the CPU fallback engine's)
+    slow:S      sleep S seconds (heartbeats continue), then ok
+    hang        keep heartbeating, never reply — killed at the deadline
+    stall       stop ALL output and sleep forever — killed by the
+                heartbeat watchdog
+    crash:N     exit immediately with status N
+    corrupt     write garbage bytes into the frame stream
+    err         reply with an err frame (host stays alive)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+from .frames import FrameError, PipeClosed, read_frame, write_frame
+
+FAKE_CP = 777  # default signature score for "ok" responses
+
+NAMED_SCRIPTS = {
+    # one-fault scripts, then recovered: the canonical chaos menu
+    "ok": {"chunks": ["ok"]},
+    "hang": {"chunks": ["hang", "ok"]},
+    "stall": {"chunks": ["stall", "ok"]},
+    "crash": {"chunks": ["crash:9", "ok"]},
+    "corrupt": {"chunks": ["corrupt", "ok"]},
+    "slow": {"chunks": ["slow:2.0", "ok"]},
+    "err": {"chunks": ["err", "ok"]},
+    # dies repeatedly, then recovers — trips a small-threshold breaker
+    # and lets a later probe restore the primary path
+    "flap": {"chunks": ["crash:9", "crash:9", "crash:9", "ok"]},
+    # boot-time faults: warmup that never heartbeats / dies / crawls
+    "boot-stall": {"boot": ["stall", "ready"]},
+    "boot-crash": {"boot": ["crash:7", "ready"]},
+    "boot-slow": {"boot": ["slow:3.0"]},
+}
+
+
+def _load_script(spec: str) -> dict:
+    if spec in NAMED_SCRIPTS:
+        return NAMED_SCRIPTS[spec]
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    return json.loads(spec)
+
+
+def _action(seq, index, default):
+    if not seq:
+        return default
+    return seq[min(index, len(seq) - 1)]
+
+
+class _State:
+    """Cross-incarnation counters, persisted so respawns advance the
+    script instead of replaying it."""
+
+    def __init__(self, path):
+        self.path = path
+        self.data = {"boot": 0, "chunks": 0}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.data.update(json.load(f))
+            except (OSError, ValueError):
+                pass
+
+    def bump(self, key: str) -> int:
+        n = self.data[key]
+        self.data[key] = n + 1
+        if self.path:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.data, f)
+            os.replace(tmp, self.path)
+        return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fishnet-tpu-fake-host")
+    p.add_argument("--script", required=True,
+                   help="named script, inline JSON, or @path")
+    p.add_argument("--state", default=None,
+                   help="JSON file persisting script position across respawns")
+    p.add_argument("--hb-interval", type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    script = _load_script(args.script)
+    state = _State(args.state)
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+
+    wlock = threading.Lock()
+    stalled = threading.Event()
+
+    def send(obj: dict) -> None:
+        with wlock:
+            write_frame(stdout, obj)
+
+    def ticker() -> None:
+        seq = 0
+        while not stalled.wait(args.hb_interval):
+            seq += 1
+            try:
+                send({"t": "hb", "phase": "fake", "busy_s": 0.0, "seq": seq})
+            except OSError:
+                os._exit(1)
+
+    threading.Thread(target=ticker, daemon=True).start()
+
+    def freeze() -> None:
+        stalled.set()  # heartbeats cease; process lingers until killed
+        while True:
+            time.sleep(3600)
+
+    boot = _action(script.get("boot"), state.bump("boot"), "ready")
+    if boot.startswith("crash:"):
+        os._exit(int(boot.split(":", 1)[1]))
+    elif boot == "stall":
+        freeze()
+    elif boot.startswith("slow:"):
+        time.sleep(float(boot.split(":", 1)[1]))
+    send({"t": "ready"})
+
+    while True:
+        try:
+            msg = read_frame(stdin)
+        except (PipeClosed, FrameError):
+            return 0
+        t = msg.get("t")
+        if t == "quit":
+            return 0
+        if t != "go":
+            continue
+        action = _action(script.get("chunks"), state.bump("chunks"), "ok")
+        if action.startswith("crash:"):
+            os._exit(int(action.split(":", 1)[1]))
+        elif action == "stall":
+            freeze()
+        elif action == "hang":
+            while True:  # heartbeats keep flowing; never answer
+                time.sleep(3600)
+        elif action == "corrupt":
+            with wlock:
+                stdout.write(b"\xde\xad\xbe\xef" * 8)
+                stdout.flush()
+            freeze()
+        elif action == "err":
+            send({"t": "err", "id": msg.get("id"),
+                  "error": "scripted engine error"})
+            continue
+        else:
+            cp = FAKE_CP
+            if action.startswith("slow:"):
+                time.sleep(float(action.split(":", 1)[1]))
+            elif action.startswith("ok:"):
+                cp = int(action.split(":", 1)[1])
+            positions = msg.get("chunk", {}).get("positions", [])
+            send({
+                "t": "ok",
+                "id": msg.get("id"),
+                "responses": [
+                    {
+                        "position_index": wp.get("position_index"),
+                        "url": wp.get("url"),
+                        "scores": [[None, {"cp": cp}]],
+                        "pvs": [[None, ["e2e4"]]],
+                        "best_move": "e2e4",
+                        "depth": 1,
+                        "nodes": 1,
+                        "time_s": 0.001,
+                        "nps": 1000,
+                    }
+                    for wp in positions
+                ],
+            })
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Length-framed JSON messages for the supervisor↔host pipe protocol.
+
+One frame = 4-byte big-endian payload length + UTF-8 JSON object. JSON
+(not pickle) so a corrupt or adversarial child can at worst produce a
+`FrameError`, never code execution in the parent; the length prefix is
+bounded so a garbage header can't trigger an unbounded read.
+
+Used on both sides of the pipe: synchronous helpers for the child host
+(blocking stdio) and an asyncio helper for the parent supervisor.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import BinaryIO
+
+HEADER = struct.Struct(">I")
+# analysis replies carry ≤6 positions of multipv×depth matrices — even a
+# pathological frame is far under this; anything bigger is corruption
+MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+
+class FrameError(Exception):
+    """Framing-level corruption: bad length, truncated payload, or
+    undecodable JSON. The peer process can no longer be trusted and must
+    be killed (supervisor) or exit (host)."""
+
+
+class PipeClosed(Exception):
+    """Clean EOF between frames: the peer went away."""
+
+
+def encode(obj: dict) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame too large: {len(payload)} bytes")
+    return HEADER.pack(len(payload)) + payload
+
+
+def _decode_payload(payload: bytes) -> dict:
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"undecodable frame: {e}") from e
+    if not isinstance(obj, dict):
+        raise FrameError(f"frame is not an object: {type(obj).__name__}")
+    return obj
+
+
+def write_frame(fp: BinaryIO, obj: dict) -> None:
+    """Child-side blocking write (caller holds any needed lock)."""
+    fp.write(encode(obj))
+    fp.flush()
+
+
+def _read_exact(fp: BinaryIO, n: int, *, at_boundary: bool) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = fp.read(n - len(buf))
+        if not part:
+            if at_boundary and not buf:
+                raise PipeClosed()
+            raise FrameError("truncated frame")
+        buf += part
+    return buf
+
+
+def read_frame(fp: BinaryIO) -> dict:
+    """Child-side blocking read. Raises PipeClosed on clean EOF."""
+    header = _read_exact(fp, HEADER.size, at_boundary=True)
+    (length,) = HEADER.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds cap")
+    return _decode_payload(_read_exact(fp, length, at_boundary=False))
+
+
+async def read_frame_async(reader) -> dict:
+    """Parent-side read from an asyncio StreamReader. Raises PipeClosed
+    on clean EOF at a frame boundary, FrameError on corruption."""
+    import asyncio
+
+    try:
+        header = await reader.readexactly(HEADER.size)
+    except asyncio.IncompleteReadError as e:
+        if not e.partial:
+            raise PipeClosed() from e
+        raise FrameError("truncated frame header") from e
+    (length,) = HEADER.unpack(header)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"frame length {length} exceeds cap")
+    try:
+        payload = await reader.readexactly(length)
+    except asyncio.IncompleteReadError as e:
+        raise FrameError("truncated frame payload") from e
+    return _decode_payload(payload)

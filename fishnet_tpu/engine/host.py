@@ -1,0 +1,156 @@
+"""Engine host: the child-process side of the supervised engine.
+
+Runs ONE engine (TPU by default) behind the length-framed pipe protocol
+(engine/frames.py) so the parent supervisor can hard-kill it when the
+device wedges — restoring the reference's "an engine is always killable"
+invariant (reference src/main.rs:263-390) that an in-process JAX dispatch
+breaks (a blocked device call keeps its executor thread, the engine lock,
+and the device forever; docs/tpu-hang.md).
+
+Protocol (all frames are JSON objects with a "t" tag):
+
+  child → parent
+    hb     {phase, busy_s, seq}   ticker thread, every --hb-interval
+    ready  {}                     warmup finished; chunks may be sent
+    log    {msg}                  relayed to the parent's logger
+    ok     {id, responses}        chunk result (client/ipc.py wire form)
+    err    {id, error}            chunk failed but the host is still sane
+  parent → child
+    go     {id, chunk}            analyse one chunk
+    quit   {}                     clean shutdown
+
+Liveness contract: the ticker thread keeps beating through a blocked
+device dispatch (JAX releases the GIL), so a silent heartbeat stream
+means the process is frozen or dead — the supervisor kills on that. A
+flowing stream with phase=search busy past the chunk deadline is the
+device-hang signature — the supervisor kills on that too. Warmup is
+allowed to run long (minutes of XLA compiles) exactly because its
+heartbeats keep flowing with phase=warmup.
+
+Run as:  python -m fishnet_tpu.engine.host --backend tpu|py [...]
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import threading
+
+from ..client.ipc import chunk_from_wire, response_to_wire
+from ..utils.heartbeat import PhaseTracker
+from .frames import FrameError, PipeClosed, read_frame, write_frame
+
+
+def _build_engine(args, log):
+    if args.backend == "py":
+        from .pyengine import PyEngine
+
+        return PyEngine(max_depth=args.depth or 3)
+    from .tpu import TpuEngine
+
+    engine = TpuEngine(
+        weights_path=args.weights or None,
+        max_depth=args.depth or 12,
+    )
+    if not args.skip_warmup:
+        engine.warmup(None, log)
+        # variant programs compile in the background, same as the old
+        # in-process wiring (client/app.py round 5) — chunks interleave
+        # behind the engine lock while the remaining shapes warm
+        threading.Thread(
+            target=lambda: engine.warmup_variants(log), daemon=True
+        ).start()
+    return engine
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="fishnet-tpu-engine-host")
+    p.add_argument("--backend", choices=["tpu", "py"], default="tpu")
+    p.add_argument("--weights", default=None)
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--hb-interval", type=float, default=1.0)
+    p.add_argument("--skip-warmup", action="store_true")
+    args = p.parse_args(argv)
+
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    # anything the engine prints must not corrupt the frame stream
+    sys.stdout = sys.stderr
+
+    wlock = threading.Lock()
+    phases = PhaseTracker("boot")
+
+    def send(obj: dict) -> None:
+        with wlock:
+            write_frame(stdout, obj)
+
+    def log(msg) -> None:
+        try:
+            send({"t": "log", "msg": str(msg)})
+        except OSError:
+            pass
+
+    stop = threading.Event()
+
+    def ticker() -> None:
+        while not stop.wait(args.hb_interval):
+            snap = phases.snapshot()
+            snap["t"] = "hb"
+            try:
+                send(snap)
+            except OSError:
+                os._exit(1)  # parent gone; nothing left to serve
+
+    threading.Thread(target=ticker, daemon=True).start()
+
+    phases.enter("warmup")
+    try:
+        engine = _build_engine(args, log)
+    except Exception as e:
+        log(f"engine construction/warmup failed: {type(e).__name__}: {e}")
+        return 1
+    send({"t": "ready"})
+    phases.enter("idle")
+
+    while True:
+        try:
+            msg = read_frame(stdin)
+        except PipeClosed:
+            break
+        except FrameError as e:
+            log(f"protocol error from supervisor: {e}")
+            return 2
+        t = msg.get("t")
+        if t == "quit":
+            break
+        if t != "go":
+            log(f"ignoring unknown frame type {t!r}")
+            continue
+        chunk = chunk_from_wire(msg["chunk"])
+        phases.enter("search")
+        try:
+            responses = asyncio.run(engine.go_multiple(chunk))
+        except Exception as e:
+            send({
+                "t": "err",
+                "id": msg.get("id"),
+                "error": f"{type(e).__name__}: {e}",
+            })
+        else:
+            send({
+                "t": "ok",
+                "id": msg.get("id"),
+                "responses": [response_to_wire(r) for r in responses],
+            })
+        phases.enter("idle")
+
+    try:
+        asyncio.run(engine.close())
+    except Exception:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,450 @@
+"""Process-isolated engine supervisor: proxy + watchdog + circuit breaker.
+
+The reference client's core robustness invariant is that an engine is
+always killable: the per-core worker races each chunk against its
+deadline and kills/respawns the Stockfish *subprocess* on overrun
+(reference src/main.rs:263-390). The in-process TPU engine broke that
+invariant — a wedged device leaves a zombie executor thread holding the
+engine lock forever (docs/tpu-hang.md). `SupervisedEngine` restores it
+by hosting the engine in a child process (engine/host.py) behind the
+`Engine` protocol:
+
+- **Phase heartbeats** (engine/frames.py protocol) prove the child is
+  alive; the watchdog hard-kills it when the stream stalls for
+  `hb_timeout`, or when an in-flight chunk overruns its deadline (the
+  device-hang signature: heartbeats flow, the search phase never ends).
+- **Respawn** is gated by `RandomizedBackoff` (reset on the first
+  successful chunk) and re-runs the child's warmup, whose long XLA
+  compiles are covered by warmup-phase heartbeats rather than a fixed
+  timeout.
+- **Circuit breaker**: after `breaker_threshold` child deaths within
+  `breaker_window` seconds, the flavor degrades to the pure-Python CPU
+  engine (engine/pyengine.py) so the client keeps acquiring and
+  submitting work while the device is wedged. Every `probe_interval`
+  seconds one chunk probes the child path; a successful probe restores
+  it.
+
+Fault paths are exercised deterministically by pointing `host_cmd` at
+the scriptable fake host (engine/fakehost.py); tests/test_supervisor.py
+covers every branch on CPU, and tools/chaos.py replays the same scripts
+interactively.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from collections import deque
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Deque, List, Optional
+
+from ..client.backoff import RandomizedBackoff
+from ..client.ipc import Chunk, PositionResponse, chunk_to_wire, responses_from_wire
+from ..client.logger import Logger
+from .base import EngineError
+from .frames import FrameError, PipeClosed, encode, read_frame_async
+
+# the child must be able to `import fishnet_tpu` no matter where the
+# parent was launched from
+_PKG_PARENT = str(Path(__file__).resolve().parents[2])
+
+
+def default_host_cmd(
+    backend: str = "tpu",
+    weights: Optional[str] = None,
+    depth: Optional[int] = None,
+    hb_interval: float = 1.0,
+) -> List[str]:
+    cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.host",
+        "--backend", backend, "--hb-interval", str(hb_interval),
+    ]
+    if weights:
+        cmd += ["--weights", str(weights)]
+    if depth is not None:
+        cmd += ["--depth", str(depth)]
+    return cmd
+
+
+@dataclass
+class SupervisorStats:
+    """Plain counters; introspected by tests and tools/chaos.py."""
+
+    spawns: int = 0
+    deaths: int = 0  # involuntary child exits + supervisor kills
+    kills: int = 0
+    hb_stalls: int = 0
+    deadline_kills: int = 0
+    protocol_errors: int = 0
+    breaker_trips: int = 0
+    breaker_resets: int = 0
+    probes: int = 0
+    fallback_chunks: int = 0
+    chunks_ok: int = 0
+
+
+def _consume_exc(fut: asyncio.Future) -> None:
+    # futures may be resolved with an exception after their awaiter gave
+    # up (kill races); retrieve it so asyncio doesn't log "never retrieved"
+    if not fut.cancelled():
+        fut.exception()
+
+
+class SupervisedEngine:
+    """`Engine`-protocol proxy to a child engine host.
+
+    Reusable after `close()` (the worker's drop-and-respawn pattern
+    closes the engine on any error and asks the factory again — the
+    factory caches this object, so breaker state survives the drop)."""
+
+    def __init__(
+        self,
+        host_cmd: Optional[List[str]] = None,
+        *,
+        backend: str = "tpu",
+        weights_path: Optional[str] = None,
+        max_depth: Optional[int] = None,
+        logger: Optional[Logger] = None,
+        hb_interval: float = 1.0,
+        hb_timeout: Optional[float] = None,
+        deadline_margin: float = 0.15,
+        breaker_threshold: int = 3,
+        breaker_window: float = 600.0,
+        probe_interval: float = 60.0,
+        fallback_factory=None,
+        backoff: Optional[RandomizedBackoff] = None,
+        env: Optional[dict] = None,
+    ) -> None:
+        self.host_cmd = host_cmd or default_host_cmd(
+            backend=backend, weights=weights_path, depth=max_depth,
+            hb_interval=hb_interval,
+        )
+        self.logger = logger or Logger()
+        self.hb_interval = hb_interval
+        # N missed beats = dead, not slow: generous enough for scheduler
+        # jitter, far under any chunk deadline
+        self.hb_timeout = hb_timeout if hb_timeout is not None else 8 * hb_interval
+        self.deadline_margin = deadline_margin
+        self.breaker_threshold = breaker_threshold
+        self.breaker_window = breaker_window
+        self.probe_interval = probe_interval
+        self.fallback_factory = fallback_factory
+        self.env = env
+        self.stats = SupervisorStats()
+
+        self._lock = asyncio.Lock()  # one in-flight chunk, like TpuEngine
+        self._backoff = backoff or RandomizedBackoff()
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._reader: Optional[asyncio.Task] = None
+        self._ready: Optional[asyncio.Future] = None
+        self._pending = None  # (go id, future) for the in-flight chunk
+        self._last_frame = 0.0
+        self._phase: dict = {}
+        self._down_noted = True  # no live child yet
+        self._closing = False
+        self._go_id = 0
+        self._deaths: Deque[float] = deque()
+        self._breaker_open = False
+        self._next_probe = 0.0
+        self._fallback = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        """Spawn the child and wait for warmup (heartbeat-governed, no
+        fixed timeout — XLA compiles run minutes with phase=warmup beats).
+        Called by app startup; `go_multiple` also self-heals lazily."""
+        async with self._lock:
+            await self._ensure_ready(None)
+
+    async def close(self) -> None:
+        self._closing = True
+        try:
+            proc = self.proc
+            if proc is not None and proc.returncode is None:
+                try:
+                    await self._send({"t": "quit"})
+                    await asyncio.wait_for(proc.wait(), timeout=2.0)
+                except (EngineError, asyncio.TimeoutError):
+                    await self._kill("shutdown", count=False)
+            if self._reader is not None:
+                self._reader.cancel()
+                await asyncio.gather(self._reader, return_exceptions=True)
+            if self._fallback is not None:
+                fallback, self._fallback = self._fallback, None
+                await fallback.close()
+        finally:
+            self.proc = None
+            self._reader = None
+            self._ready = None
+            self._pending = None
+            self._down_noted = True
+            self._closing = False
+
+    # ------------------------------------------------------------- dispatch
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        async with self._lock:
+            if self._breaker_open:
+                if time.monotonic() >= self._next_probe:
+                    self.stats.probes += 1
+                    self.logger.info(
+                        "Circuit breaker: probing the supervised engine path"
+                    )
+                    try:
+                        responses = await self._go_child(chunk)
+                    except EngineError as e:
+                        self._next_probe = time.monotonic() + self.probe_interval
+                        self.logger.warn(
+                            f"Probe failed ({e}); staying on CPU fallback"
+                        )
+                        return await self._go_fallback(chunk)
+                    self._breaker_open = False
+                    self.stats.breaker_resets += 1
+                    self.logger.headline(
+                        "Circuit breaker CLOSED: supervised engine recovered"
+                    )
+                    return responses
+                return await self._go_fallback(chunk)
+            try:
+                return await self._go_child(chunk)
+            except EngineError:
+                if self._breaker_open and time.monotonic() < chunk.deadline:
+                    # this very death tripped the breaker: salvage the
+                    # chunk on the fallback instead of failing it
+                    return await self._go_fallback(chunk)
+                raise
+
+    async def _go_fallback(self, chunk: Chunk) -> List[PositionResponse]:
+        if self._fallback is None:
+            if self.fallback_factory is not None:
+                self._fallback = self.fallback_factory()
+            else:
+                from .pyengine import PyEngine
+
+                self._fallback = PyEngine()
+        self.stats.fallback_chunks += 1
+        try:
+            return await self._fallback.go_multiple(chunk)
+        except EngineError:
+            raise
+        except Exception as e:
+            raise EngineError(f"fallback engine failed: {e}") from e
+
+    async def _go_child(self, chunk: Chunk) -> List[PositionResponse]:
+        deadline = chunk.deadline - self.deadline_margin
+        await self._ensure_ready(deadline)
+        self._go_id += 1
+        gid = self._go_id
+        fut = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(_consume_exc)
+        self._pending = (gid, fut)
+        try:
+            await self._send({"t": "go", "id": gid, "chunk": chunk_to_wire(chunk)})
+            reply = await self._watch(
+                fut, deadline, kill_on_deadline=True,
+                label=f"chunk of batch {chunk.work.id}",
+            )
+        finally:
+            self._pending = None
+        if reply.get("t") == "err":
+            # the child handled the failure itself and is still sane
+            raise EngineError(f"engine host: {reply.get('error')}")
+        try:
+            responses = responses_from_wire(chunk.work, reply["responses"])
+        except (KeyError, TypeError, ValueError) as e:
+            self.stats.protocol_errors += 1
+            await self._kill(f"malformed ok frame: {e}")
+            raise EngineError(f"engine host sent a malformed result: {e}") from e
+        self._deaths.clear()
+        self._backoff.reset()
+        self.stats.chunks_ok += 1
+        return responses
+
+    # ------------------------------------------------------------- watchdog
+
+    async def _watch(self, fut, deadline, kill_on_deadline: bool, label: str):
+        """Await `fut` under watchdog policy: kill on heartbeat stall
+        (always) or deadline overrun (chunks: yes; warmup: give up but
+        let the child keep compiling for the next chunk)."""
+        while True:
+            if fut.done():
+                return fut.result()  # raises EngineError if the child died
+            now = time.monotonic()
+            hb_age = now - self._last_frame
+            if hb_age > self.hb_timeout:
+                self.stats.hb_stalls += 1
+                await self._kill(
+                    f"missed heartbeats for {hb_age:.1f}s during {label}"
+                )
+                raise EngineError(
+                    f"engine host missed heartbeats during {label}"
+                )
+            if deadline is not None and now >= deadline:
+                if kill_on_deadline:
+                    self.stats.deadline_kills += 1
+                    phase = self._phase.get("phase", "?")
+                    await self._kill(
+                        f"{label} overran its deadline (phase={phase})"
+                    )
+                    raise EngineError(f"{label} overran its deadline")
+                raise EngineError(f"engine host not ready in time for {label}")
+            timeout = max(self.hb_timeout - hb_age, self.hb_interval / 4)
+            if deadline is not None:
+                timeout = min(timeout, deadline - now)
+            await asyncio.wait([fut], timeout=max(timeout, 0.01))
+
+    async def _ensure_ready(self, deadline: Optional[float]) -> None:
+        # _down_noted, not returncode: a crashed child's returncode stays
+        # None until the event loop reaps it, but the reader task notes
+        # the death the moment the pipe closes
+        if self.proc is None or self._down_noted or self.proc.returncode is not None:
+            if self._backoff.pending():
+                delay = self._backoff.next()
+                if deadline is not None and time.monotonic() + delay >= deadline:
+                    raise EngineError(
+                        "respawn backoff would outlast the chunk deadline"
+                    )
+                self.logger.warn(
+                    f"Waiting {delay:.1f}s before respawning the engine host"
+                )
+                await asyncio.sleep(delay)
+            await self._spawn()
+        assert self._ready is not None
+        if not self._ready.done():
+            await self._watch(
+                self._ready, deadline, kill_on_deadline=False, label="warmup"
+            )
+        else:
+            self._ready.result()  # re-raise a recorded startup failure
+
+    async def _spawn(self) -> None:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _PKG_PARENT + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        if self.env:
+            env.update({k: str(v) for k, v in self.env.items()})
+        try:
+            self.proc = await asyncio.create_subprocess_exec(
+                *self.host_cmd,
+                stdin=asyncio.subprocess.PIPE,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=None,  # engine logs/tracebacks pass through
+                # own process group: ^C at the client must not reach the
+                # engine mid-chunk (same as engine/uci.py)
+                start_new_session=True,
+                env=env,
+            )
+        except OSError as e:
+            self._down_noted = False
+            self._note_down(f"spawn failed: {e}")
+            raise EngineError(f"failed to spawn engine host: {e}") from e
+        self.stats.spawns += 1
+        self._down_noted = False
+        self._last_frame = time.monotonic()
+        self._phase = {}
+        ready = asyncio.get_running_loop().create_future()
+        ready.add_done_callback(_consume_exc)
+        self._ready = ready
+        self._reader = asyncio.ensure_future(self._read_loop(self.proc, ready))
+
+    async def _read_loop(self, proc, ready_fut) -> None:
+        reason = "engine host exited"
+        try:
+            while True:
+                try:
+                    msg = await read_frame_async(proc.stdout)
+                except PipeClosed:
+                    rc = proc.returncode
+                    if rc is not None and rc != 0:
+                        reason = f"engine host exited with status {rc}"
+                    break
+                except FrameError as e:
+                    self.stats.protocol_errors += 1
+                    reason = f"corrupt frame: {e}"
+                    await self._kill(reason)
+                    break
+                self._last_frame = time.monotonic()
+                t = msg.get("t")
+                if t == "hb":
+                    self._phase = msg
+                elif t == "ready":
+                    if not ready_fut.done():
+                        ready_fut.set_result(True)
+                elif t in ("ok", "err"):
+                    if self._pending is not None and self._pending[0] == msg.get("id"):
+                        fut = self._pending[1]
+                        if not fut.done():
+                            fut.set_result(msg)
+                elif t == "log":
+                    self.logger.info(f"engine host: {msg.get('msg', '')}")
+        except asyncio.CancelledError:
+            raise
+        finally:
+            err = EngineError(reason)
+            if not ready_fut.done():
+                ready_fut.set_exception(err)
+            if self._pending is not None and not self._pending[1].done():
+                self._pending[1].set_exception(err)
+            self._note_down(reason)
+
+    # ------------------------------------------------------- death handling
+
+    async def _kill(self, reason: str, count: bool = True) -> None:
+        proc = self.proc
+        if proc is None or proc.returncode is not None:
+            return
+        if count:
+            self.stats.kills += 1
+            self.logger.warn(f"Killing engine host: {reason}")
+            self._note_down(reason)
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
+        try:
+            await asyncio.wait_for(proc.wait(), timeout=10.0)
+        except asyncio.TimeoutError:
+            self.logger.error("Engine host ignored SIGKILL (unreapable?)")
+
+    def _note_down(self, reason: str) -> None:
+        """Record one involuntary child death (idempotent per incarnation)
+        and trip the circuit breaker on the Nth within the window."""
+        if self._down_noted:
+            return
+        self._down_noted = True
+        if self._closing:
+            return  # voluntary shutdown, not a fault
+        self.stats.deaths += 1
+        self._backoff.next()  # arm the respawn delay
+        now = time.monotonic()
+        self._deaths.append(now)
+        while self._deaths and now - self._deaths[0] > self.breaker_window:
+            self._deaths.popleft()
+        if not self._breaker_open and len(self._deaths) >= self.breaker_threshold:
+            self._breaker_open = True
+            self.stats.breaker_trips += 1
+            self._next_probe = now + self.probe_interval
+            self._deaths.clear()
+            self.logger.error(
+                f"Engine host died {self.breaker_threshold} times within "
+                f"{self.breaker_window:.0f}s ({reason}); circuit breaker OPEN "
+                "— degrading to the CPU fallback engine"
+            )
+        else:
+            self.logger.warn(f"Engine host down: {reason}")
+
+    # ------------------------------------------------------------- plumbing
+
+    async def _send(self, obj: dict) -> None:
+        proc = self.proc
+        if proc is None or proc.stdin is None:
+            raise EngineError("engine host is not running")
+        try:
+            proc.stdin.write(encode(obj))
+            await proc.stdin.drain()
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            raise EngineError(f"engine host pipe write failed: {e}") from e

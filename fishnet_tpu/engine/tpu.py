@@ -128,6 +128,8 @@ class TpuEngine:
         max_depth: int = 12,  # production value flows from configure.tpu_depth
         seed: int = 1234,
         tt_size_log2: int = 21,  # 2M slots ≈ 24 MiB HBM; 0 disables
+        max_lanes: Optional[int] = None,  # single-dispatch lane ceiling
+        logger=None,  # client Logger for operational warnings; stderr if None
     ) -> None:
         from ..utils import enable_compile_cache
 
@@ -187,6 +189,16 @@ class TpuEngine:
                 params = nnue.quantize_int8(params)
         self.params = params
         self.max_depth = max_depth
+        # B=2048 falls off the VMEM cliff on v5e (docs/tpu-hang.md round 5:
+        # ~1024 lanes is the ceiling) — never let one dispatch exceed it;
+        # multipv is the only shape that can (every legal root move of
+        # every chunk position becomes a lane)
+        self.max_lanes = (
+            max_lanes
+            if max_lanes is not None
+            else int(os.environ.get("FISHNET_TPU_MAX_LANES", "1024"))
+        )
+        self._logger = logger
         # FISHNET_TPU_TRACE=1: per-dispatch / per-depth timing lines to
         # stderr (verdict A1: a hang or slow depth must be localizable
         # from logs — compile-vs-run shows up as a slow FIRST dispatch
@@ -196,6 +208,12 @@ class TpuEngine:
             if os.environ.get("FISHNET_TPU_TRACE")
             else None
         )
+
+    def _warn(self, msg: str) -> None:
+        if self._logger is not None:
+            self._logger.warn(msg)
+        else:
+            print(f"W: {msg}", file=sys.stderr, flush=True)
 
     def warmup(self, buckets=None, log=None, deep=None) -> None:
         """Pre-compile the hot search program for every production lane
@@ -797,9 +815,86 @@ class TpuEngine:
         server budget (remaining//len(legal) per lane per round, so a
         round never exceeds the remaining budget). Wall-clock is what
         matters on TPU — the lanes run in the same lockstep dispatch —
-        and the budget check stops deepening once the pool is spent."""
+        and the budget check stops deepening once the pool is spent.
+
+        Lane ceiling: multipv is the only path whose lane count scales
+        with chunk content (positions × legal moves), so it is the only
+        one that can blow past `max_lanes` (~1024 on v5e before the VMEM
+        cliff, docs/tpu-hang.md round 5). Positions are partitioned
+        greedily into dispatch groups of ≤ max_lanes lanes, searched
+        sequentially against the shared chunk deadline."""
         live = [i for i, p in enumerate(positions) if p.outcome() is None]
         legal: dict[int, list] = {i: positions[i].legal_moves() for i in live}
+
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_lanes = 0
+        for i in live:
+            n = len(legal[i])
+            if cur and cur_lanes + n > self.max_lanes:
+                groups.append(cur)
+                cur, cur_lanes = [], 0
+            # a single position over the ceiling still gets its own group:
+            # root-move lanes are indivisible (chess tops out ~218 legal,
+            # far under the production ceiling — only tiny test ceilings
+            # can hit this)
+            cur.append(i)
+            cur_lanes += n
+        if cur:
+            groups.append(cur)
+        if len(groups) > 1:
+            total_lanes = sum(len(legal[i]) for i in live)
+            self._warn(
+                f"multipv chunk wants {total_lanes} lanes, over the "
+                f"{self.max_lanes}-lane device ceiling; splitting into "
+                f"{len(groups)} sequential dispatch groups (expect "
+                "proportionally longer wall-clock against the same deadline)"
+            )
+
+        scores = [Matrix() for _ in positions]
+        pvs = [Matrix() for _ in positions]
+        depth_reached = [0] * len(positions)
+        best_moves: List[Optional[str]] = [None] * len(positions)
+        nodes_total = [0] * len(positions)
+
+        for group in groups:
+            self._analyse_multipv_group(
+                chunk, positions, games, multipv, target_depth, budget,
+                group, legal, scores, pvs, depth_reached, best_moves,
+                nodes_total,
+            )
+
+        if any(depth_reached[i] == 0 for i in live):
+            raise EngineError(
+                "chunk deadline expired before depth 1 completed (multipv)"
+            )
+
+        elapsed = max(time.monotonic() - started, 1e-6)
+        times = self._apportion_time(elapsed, nodes_total)
+        responses = []
+        for i, wp in enumerate(chunk.positions):
+            if i not in live:
+                responses.append(
+                    self._terminal_response(chunk, wp, positions[i], times[i])
+                )
+                continue
+            responses.append(
+                PositionResponse(
+                    work=chunk.work, position_index=wp.position_index,
+                    url=wp.url, scores=scores[i], pvs=pvs[i],
+                    best_move=best_moves[i], depth=depth_reached[i],
+                    nodes=nodes_total[i], time_s=times[i],
+                    nps=int(nodes_total[i] / times[i]) if times[i] > 0 else None,
+                )
+            )
+        return responses
+
+    def _analyse_multipv_group(self, chunk, positions, games, multipv,
+                               target_depth, budget, live, legal, scores,
+                               pvs, depth_reached, best_moves, nodes_total):
+        """One ≤max_lanes dispatch group of `_analyse_multipv`: build the
+        lane table for `live`'s root moves and iterate depths, folding
+        results into the caller's shared per-position accumulators."""
         # lane table: (position index, move index) per lane
         lane_pos: List[int] = []
         lane_move: List[int] = []
@@ -809,12 +904,6 @@ class TpuEngine:
                 lane_pos.append(i)
                 lane_move.append(j)
                 boards.append(from_position(positions[i].push(m)))
-
-        scores = [Matrix() for _ in positions]
-        pvs = [Matrix() for _ in positions]
-        depth_reached = [0] * len(positions)
-        best_moves: List[Optional[str]] = [None] * len(positions)
-        nodes_total = [0] * len(positions)
 
         if boards:
             B = self._pad(max(len(boards), 64))
@@ -897,40 +986,16 @@ class TpuEngine:
                 if not progressed or time.monotonic() >= deadline:
                     break
 
-        if any(depth_reached[i] == 0 for i in live):
-            raise EngineError(
-                "chunk deadline expired before depth 1 completed (multipv)"
-            )
-
-        if boards and self.trace:
-            # budget honesty: root-move lanes make a position spend up to
-            # ~len(legal)× a single-PV search's nodes against the same
-            # server budget — keep the actual consumption visible
-            spent = {i: per_pos_budget - remaining[i] for i in live}
-            self.trace(
-                "multipv budget: "
-                + " ".join(
-                    f"pos{i}={spent[i]}/{per_pos_budget}"
-                    f"({len(legal[i])}lanes)"
-                    for i in live
+            if self.trace:
+                # budget honesty: root-move lanes make a position spend up
+                # to ~len(legal)× a single-PV search's nodes against the
+                # same server budget — keep the actual consumption visible
+                spent = {i: per_pos_budget - remaining[i] for i in live}
+                self.trace(
+                    "multipv budget: "
+                    + " ".join(
+                        f"pos{i}={spent[i]}/{per_pos_budget}"
+                        f"({len(legal[i])}lanes)"
+                        for i in live
+                    )
                 )
-            )
-        elapsed = max(time.monotonic() - started, 1e-6)
-        times = self._apportion_time(elapsed, nodes_total)
-        responses = []
-        for i, wp in enumerate(chunk.positions):
-            if i not in live:
-                responses.append(
-                    self._terminal_response(chunk, wp, positions[i], times[i])
-                )
-                continue
-            responses.append(
-                PositionResponse(
-                    work=chunk.work, position_index=wp.position_index,
-                    url=wp.url, scores=scores[i], pvs=pvs[i],
-                    best_move=best_moves[i], depth=depth_reached[i],
-                    nodes=nodes_total[i], time_s=times[i],
-                    nps=int(nodes_total[i] / times[i]) if times[i] > 0 else None,
-                )
-            )
-        return responses

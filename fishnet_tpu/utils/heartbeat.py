@@ -1,0 +1,57 @@
+"""Phase heartbeats: the liveness scheme proven in bench.py, generalized.
+
+A hang is only diagnosable if the last recorded phase localizes it
+(compile vs dispatch vs idle — docs/tpu-hang.md). Two pieces:
+
+- `stamp`: the timestamped stderr line bench.py streams per phase
+  transition, shared so every harness formats hangs the same way.
+- `PhaseTracker`: thread-safe current-phase state for processes whose
+  liveness is *watched from outside* (engine/host.py): the worker thread
+  marks phase transitions, a ticker thread snapshots it into heartbeat
+  frames. The watchdog policy this supports: heartbeat frames prove the
+  process is alive (a stopped stream means frozen/dead — kill), while the
+  carried phase + busy time lets deadlines be enforced per phase (a
+  device hang shows as `search` busy beyond the chunk deadline even
+  though frames keep flowing, because JAX's blocked dispatch releases
+  the GIL and the ticker keeps running).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+
+def stamp(t0: float, msg: str, tag: str = "hb", file: Optional[TextIO] = None) -> None:
+    """One timestamped heartbeat line on stderr (flushed immediately: the
+    tail must survive a hard kill)."""
+    print(
+        f"[{tag} {time.time() - t0:7.1f}s] {msg}",
+        file=file or sys.stderr,
+        flush=True,
+    )
+
+
+class PhaseTracker:
+    """Current phase + entry time, safe to snapshot from another thread."""
+
+    def __init__(self, phase: str = "start") -> None:
+        self._lock = threading.Lock()
+        self._phase = phase
+        self._since = time.monotonic()
+        self._seq = 0  # bumps on every transition; lets watchers see churn
+
+    def enter(self, phase: str) -> None:
+        with self._lock:
+            self._phase = phase
+            self._since = time.monotonic()
+            self._seq += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "phase": self._phase,
+                "busy_s": round(time.monotonic() - self._since, 3),
+                "seq": self._seq,
+            }

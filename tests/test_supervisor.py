@@ -1,0 +1,342 @@
+"""Supervisor fault-injection tests: every watchdog/breaker path on CPU.
+
+Each test drives `SupervisedEngine` against the scriptable fake host
+(fishnet_tpu/engine/fakehost.py) — no JAX, no device, deterministic
+faults. One asyncio.run() per test: the supervisor's reader task and
+pipe transports are bound to the loop they were created on.
+"""
+import asyncio
+import json
+import sys
+import time
+
+import pytest
+
+from fishnet_tpu.client.ipc import Chunk, WorkPosition
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.fakehost import FAKE_CP
+from fishnet_tpu.engine.supervisor import SupervisedEngine
+
+pytestmark = pytest.mark.faultinject
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def fake_cmd(script, state_path=None, hb_interval=0.05):
+    cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", script if isinstance(script, str) else json.dumps(script),
+        "--hb-interval", str(hb_interval),
+    ]
+    if state_path is not None:
+        cmd += ["--state", str(state_path)]
+    return cmd
+
+
+def make_supervisor(script, state_path=None, **kw):
+    kw.setdefault("hb_interval", 0.05)
+    kw.setdefault("hb_timeout", 0.6)
+    kw.setdefault("deadline_margin", 0.15)
+    kw.setdefault("logger", Logger(verbose=0))
+    return SupervisedEngine(fake_cmd(script, state_path), **kw)
+
+
+def make_chunk(ttl=30.0, n_positions=2, depth=1):
+    work = AnalysisWork(
+        id="supjob01",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0,
+        depth=depth,
+        multipv=None,
+    )
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=START, moves=[])
+        for i in range(n_positions)
+    ]
+    return Chunk(work=work, deadline=time.monotonic() + ttl,
+                 variant="standard", flavor=EngineFlavor.TPU,
+                 positions=positions)
+
+
+async def closing(sup):
+    return _Closing(sup)
+
+
+class _Closing:
+    def __init__(self, sup):
+        self.sup = sup
+
+    async def __aenter__(self):
+        return self.sup
+
+    async def __aexit__(self, *exc):
+        await self.sup.close()
+
+
+def fake_cp(responses):
+    return [r.scores.best().value for r in responses]
+
+
+def test_ok_roundtrip():
+    async def main():
+        async with await closing(make_supervisor({"chunks": ["ok"]})) as sup:
+            responses = await sup.go_multiple(make_chunk(n_positions=3))
+            assert len(responses) == 3
+            assert fake_cp(responses) == [FAKE_CP] * 3
+            assert [r.position_index for r in responses] == [0, 1, 2]
+            assert all(r.best_move == "e2e4" for r in responses)
+            assert sup.stats.chunks_ok == 1
+            assert sup.stats.spawns == 1
+
+    asyncio.run(main())
+
+
+def test_hang_killed_before_deadline_then_respawn(tmp_path):
+    """Device-hang signature: heartbeats keep flowing but the search never
+    returns — the watchdog must kill at the chunk deadline (not the
+    heartbeat timeout) and the failure must surface BEFORE the worker's
+    own deadline race would fire."""
+    async def main():
+        sup = make_supervisor({"chunks": ["hang", "ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            chunk = make_chunk(ttl=1.5)
+            with pytest.raises(EngineError):
+                await sup.go_multiple(chunk)
+            # surfaced before the deadline: the worker reports ChunkFailed
+            # instead of tripping its own asyncio.wait_for race
+            assert time.monotonic() < chunk.deadline
+            assert sup.stats.deadline_kills == 1
+            assert sup.stats.hb_stalls == 0  # heartbeats never stopped
+            # respawn (backoff-gated) serves the next chunk
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 2
+
+    asyncio.run(main())
+
+
+def test_stall_killed_by_heartbeat_watchdog(tmp_path):
+    """Frozen process: ALL output stops. Killed by missed heartbeats long
+    before the (distant) chunk deadline."""
+    async def main():
+        sup = make_supervisor({"chunks": ["stall", "ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            t0 = time.monotonic()
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk(ttl=30.0))
+            assert time.monotonic() - t0 < 10.0  # hb_timeout, not deadline
+            assert sup.stats.hb_stalls == 1
+            assert sup.stats.deadline_kills == 0
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())
+
+
+def test_crash_respawn_and_recover(tmp_path):
+    async def main():
+        sup = make_supervisor({"chunks": ["crash:9", "ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk())
+            assert sup.stats.deaths == 1
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 2
+            # success clears the respawn backoff and the death window
+            assert not sup._backoff.pending()
+
+    asyncio.run(main())
+
+
+def test_corrupt_frame_kills_child(tmp_path):
+    async def main():
+        sup = make_supervisor({"chunks": ["corrupt", "ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk(ttl=30.0))
+            assert sup.stats.protocol_errors >= 1
+            assert sup.stats.kills >= 1
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())
+
+
+def test_err_frame_keeps_child_alive(tmp_path):
+    """An err reply means the child handled its own failure — no kill, no
+    respawn, next chunk goes to the same incarnation."""
+    async def main():
+        sup = make_supervisor({"chunks": ["err", "ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            with pytest.raises(EngineError, match="scripted engine error"):
+                await sup.go_multiple(make_chunk())
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 1
+            assert sup.stats.deaths == 0
+
+    asyncio.run(main())
+
+
+def test_slow_chunk_survives_on_heartbeats():
+    """Slow but alive: the reply takes ~2× hb_timeout, yet flowing
+    heartbeats must keep the watchdog from a false-positive kill."""
+    async def main():
+        sup = make_supervisor({"chunks": ["slow:1.2"]}, hb_timeout=0.5)
+        async with await closing(sup):
+            responses = await sup.go_multiple(make_chunk(ttl=30.0))
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.kills == 0
+
+    asyncio.run(main())
+
+
+def test_boot_stall_killed_then_recovers(tmp_path):
+    """Warmup has no deadline (XLA compiles run minutes) but a SILENT
+    warmup is dead — the heartbeat watchdog still applies."""
+    async def main():
+        sup = make_supervisor({"boot": ["stall", "ready"], "chunks": ["ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk())
+            assert sup.stats.hb_stalls == 1
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())
+
+
+def test_boot_crash_surfaces_and_recovers(tmp_path):
+    async def main():
+        sup = make_supervisor({"boot": ["crash:7", "ready"], "chunks": ["ok"]},
+                              tmp_path / "state.json")
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk())
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())
+
+
+def test_breaker_trips_to_cpu_fallback_and_probe_recovers(tmp_path):
+    """Acceptance path: N consecutive child deaths open the breaker,
+    chunks degrade to the pure-Python CPU engine (responses still
+    produced), and a later successful probe restores the child path."""
+    async def main():
+        sup = make_supervisor(
+            {"chunks": ["crash:1", "crash:1", "ok"]},
+            tmp_path / "state.json",
+            breaker_threshold=2,
+            breaker_window=600.0,
+            probe_interval=0.4,
+        )
+        async with await closing(sup):
+            # death 1: plain failure, breaker still closed
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk())
+            assert not sup._breaker_open
+
+            # death 2 trips the breaker; the SAME chunk is salvaged on the
+            # CPU fallback, so responses are still produced
+            responses = await sup.go_multiple(make_chunk(ttl=60.0))
+            assert sup._breaker_open
+            assert sup.stats.breaker_trips == 1
+            assert sup.stats.fallback_chunks == 1
+            assert len(responses) == 2
+            # PyEngine really searched: its scores are not the fake host's
+            # signature constant
+            assert all(r.best_move is not None for r in responses)
+            assert fake_cp(responses) != [FAKE_CP, FAKE_CP]
+
+            # breaker open, probe not due: straight to fallback, child
+            # untouched
+            responses = await sup.go_multiple(make_chunk(ttl=60.0))
+            assert sup.stats.fallback_chunks == 2
+            assert sup.stats.probes == 0
+
+            # probe due: child respawns, script says ok → breaker closes
+            await asyncio.sleep(0.45)
+            responses = await sup.go_multiple(make_chunk(ttl=60.0))
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert not sup._breaker_open
+            assert sup.stats.probes == 1
+            assert sup.stats.breaker_resets == 1
+
+            # back on the child path for good
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())
+
+
+def test_failed_probe_stays_on_fallback(tmp_path):
+    async def main():
+        sup = make_supervisor(
+            {"chunks": ["crash:1", "crash:1", "crash:1", "ok"]},
+            tmp_path / "state.json",
+            breaker_threshold=2,
+            probe_interval=0.3,
+        )
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk())
+            await sup.go_multiple(make_chunk(ttl=60.0))  # trips + salvages
+            assert sup._breaker_open
+            await asyncio.sleep(0.35)
+            # probe hits crash #3: breaker stays open, chunk still served
+            responses = await sup.go_multiple(make_chunk(ttl=60.0))
+            assert len(responses) == 2
+            assert sup._breaker_open
+            assert sup.stats.probes == 1
+            assert sup.stats.breaker_resets == 0
+            # next probe succeeds
+            await asyncio.sleep(0.35)
+            responses = await sup.go_multiple(make_chunk(ttl=60.0))
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert not sup._breaker_open
+
+    asyncio.run(main())
+
+
+def test_close_is_clean_and_object_is_reusable(tmp_path):
+    """The app's engine factory caches one supervisor; workers close() it
+    when dropping an engine. close() must not count as a death and the
+    object must serve again afterwards (fresh child)."""
+    async def main():
+        sup = make_supervisor({"chunks": ["ok"]}, tmp_path / "state.json")
+        responses = await sup.go_multiple(make_chunk())
+        assert fake_cp(responses) == [FAKE_CP] * 2
+        await sup.close()
+        assert sup.proc is None
+        assert sup.stats.deaths == 0
+        responses = await sup.go_multiple(make_chunk())
+        assert fake_cp(responses) == [FAKE_CP] * 2
+        assert sup.stats.spawns == 2
+        assert sup.stats.deaths == 0
+        await sup.close()
+
+    asyncio.run(main())
+
+
+def test_start_waits_for_ready():
+    async def main():
+        sup = make_supervisor({"boot": ["slow:0.5"], "chunks": ["ok"]})
+        async with await closing(sup):
+            t0 = time.monotonic()
+            await sup.start()
+            assert time.monotonic() - t0 >= 0.4
+            responses = await sup.go_multiple(make_chunk())
+            assert fake_cp(responses) == [FAKE_CP] * 2
+
+    asyncio.run(main())

@@ -75,6 +75,61 @@ def test_analysis_chunk(engine):
         assert res.best_move == pv[0]
 
 
+def test_multipv_lane_ceiling_splits_dispatches():
+    """docs/tpu-hang.md round 5: ~1024 lanes is the v5e ceiling. With a
+    tiny ceiling, a multipv chunk whose root moves exceed it must be
+    split into sequential dispatch groups — with a warning — and still
+    produce complete responses for every position. The device program is
+    stubbed: the partitioning is host-side logic and must be testable
+    without a dispatch."""
+    import numpy as np
+
+    class WarnCatcher:
+        def __init__(self):
+            self.messages = []
+
+        def warn(self, msg):
+            self.messages.append(msg)
+
+    sparse = "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1"  # 6 legal moves
+    logger = WarnCatcher()
+    engine = TpuEngine(max_depth=2, max_lanes=16, logger=logger)
+    dispatches = []
+
+    def fake_search(roots, depth_arr, budget_arr, deadline=None, **kw):
+        B = len(depth_arr)
+        dispatches.append(B)
+        return {
+            "done": np.ones(B, bool),
+            "score": np.full(B, 20, np.int32),
+            "pv": np.full((B, 4), -1, np.int32),
+            "pv_len": np.zeros(B, np.int32),
+            "nodes": np.ones(B, np.int32),
+        }
+
+    engine._search = fake_search
+    work = analysis_work(depth=1, multipv=2)
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=sparse, moves=[])
+        for i in range(3)  # 18 lanes total: 16-lane ceiling forces a split
+    ]
+    chunk = Chunk(
+        work=work, deadline=time.monotonic() + 120, variant="standard",
+        flavor=EngineFlavor.TPU, positions=positions,
+    )
+    responses = run(engine, chunk)
+    assert len(responses) == 3
+    for res in responses:
+        assert res.depth == 1
+        assert res.best_move is not None
+        assert res.scores.best() is not None
+        assert len(res.scores.matrix) == 2  # multipv rows intact
+    # two dispatch groups (12 + 6 lanes), one depth iteration each
+    assert len(dispatches) == 2
+    assert any("lanes" in m and "splitting" in m for m in logger.messages)
+
+
 def test_multipv_chunk(engine):
     responses = run(engine, make_chunk(analysis_work(depth=2, multipv=3), n_positions=2))
     for res in responses:

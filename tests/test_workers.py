@@ -1,0 +1,188 @@
+"""Worker failure-path tests: the deadline race, engine drop-and-respawn,
+backoff gating, ChunkFailed reporting, and clean shutdown mid-flight.
+
+Uses in-process fake engines and a scripted queue — the real engine
+failure modes (hang, crash, wedge) are exercised end-to-end against a
+child process in test_supervisor.py; here the WORKER's reactions are
+isolated."""
+import asyncio
+import time
+
+from fishnet_tpu.client.backoff import RandomizedBackoff
+from fishnet_tpu.client.ipc import Chunk, ChunkFailed, WorkPosition
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.queue import ShuttingDown
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+from fishnet_tpu.client.workers import worker
+from fishnet_tpu.engine.base import EngineError
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def make_chunk(ttl=5.0):
+    work = AnalysisWork(
+        id="wrkjob01",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0, depth=1, multipv=None,
+    )
+    return Chunk(
+        work=work, deadline=time.monotonic() + ttl, variant="standard",
+        flavor=EngineFlavor.TPU,
+        positions=[WorkPosition(work=work, position_index=0, url=None,
+                                skip=False, root_fen=START, moves=[])],
+    )
+
+
+class ScriptQueue:
+    """Hands out chunks built lazily at pull time (deadlines are absolute
+    monotonic stamps — building them up front would start their clocks
+    early), records what each pull reports, then raises ShuttingDown."""
+
+    def __init__(self, chunk_thunks):
+        self.thunks = list(chunk_thunks)
+        self.reports = []
+
+    async def pull(self, responses):
+        self.reports.append(responses)
+        if not self.thunks:
+            raise ShuttingDown()
+        return self.thunks.pop(0)()
+
+
+class OkEngine:
+    def __init__(self):
+        self.closed = False
+        self.calls = 0
+
+    async def go_multiple(self, chunk):
+        self.calls += 1
+        return ["fake-response"]
+
+    async def close(self):
+        self.closed = True
+
+
+class HangingEngine(OkEngine):
+    async def go_multiple(self, chunk):
+        self.calls += 1
+        await asyncio.sleep(3600)
+
+
+class FailingEngine(OkEngine):
+    async def go_multiple(self, chunk):
+        self.calls += 1
+        raise EngineError("injected engine failure")
+
+
+class SucceedThenFail(OkEngine):
+    async def go_multiple(self, chunk):
+        self.calls += 1
+        if self.calls == 1:
+            return ["fake-response"]
+        raise EngineError("second call fails")
+
+
+def run_worker(queue, factory):
+    asyncio.run(worker(0, queue, factory, Logger(verbose=0)))
+
+
+def listing_factory(engines, classes):
+    def factory(flavor):
+        engines.append(classes[len(engines)]())
+        return engines[-1]
+
+    return factory
+
+
+def test_hanging_engine_loses_deadline_race_and_is_dropped():
+    queue = ScriptQueue([lambda: make_chunk(ttl=0.3)] * 2)
+    engines = []
+    run_worker(queue, listing_factory(engines, [HangingEngine, HangingEngine]))
+    # both chunks timed out and were reported failed
+    failed = [r for r in queue.reports if isinstance(r, ChunkFailed)]
+    assert len(failed) == 2
+    assert all(f.batch_id == "wrkjob01" for f in failed)
+    # the wedged engine was dropped (closed) after each overrun, and a
+    # fresh one was built for the second chunk
+    assert len(engines) == 2
+    assert all(e.closed for e in engines)
+
+
+def test_engine_error_drops_engine_and_respawns():
+    queue = ScriptQueue([make_chunk] * 2)
+    engines = []
+    run_worker(queue, listing_factory(engines, [FailingEngine, OkEngine]))
+    assert isinstance(queue.reports[1], ChunkFailed)  # first chunk failed
+    assert queue.reports[2] == ["fake-response"]  # second chunk recovered
+    assert len(engines) == 2
+    assert engines[0].closed  # dropped on error
+    assert engines[1].closed  # closed at shutdown
+
+
+def test_factory_failure_reports_chunk_failed():
+    queue = ScriptQueue([make_chunk])
+
+    def factory(flavor):
+        raise RuntimeError("no engine for you")
+
+    run_worker(queue, factory)
+    assert isinstance(queue.reports[1], ChunkFailed)
+
+
+def test_expired_chunk_fails_without_touching_engine():
+    queue = ScriptQueue([lambda: make_chunk(ttl=-1.0)])
+    engines = []
+    run_worker(queue, listing_factory(engines, [OkEngine]))
+    assert isinstance(queue.reports[1], ChunkFailed)
+    assert engines[0].calls == 0
+
+
+def test_success_resets_the_tracked_backoff(monkeypatch):
+    """Regression: the old code called backoffs.get(flavor, ...).reset(),
+    resetting a THROWAAWAY instance — the tracked one kept its armed
+    delay forever, so every later respawn waited longer than it should."""
+    instances = []
+
+    class Recorder(RandomizedBackoff):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.resets = 0
+            instances.append(self)
+
+        def reset(self):
+            self.resets += 1
+            super().reset()
+
+    monkeypatch.setattr(
+        "fishnet_tpu.client.workers.RandomizedBackoff", Recorder
+    )
+    queue = ScriptQueue([make_chunk] * 3)
+    engines = []
+    run_worker(
+        queue, listing_factory(engines, [FailingEngine, SucceedThenFail])
+    )
+    # fail → armed backoff → respawn (gated) → success → fail again
+    assert isinstance(queue.reports[1], ChunkFailed)
+    assert queue.reports[2] == ["fake-response"]
+    assert isinstance(queue.reports[3], ChunkFailed)
+    # the TRACKED backoff (first instance stored for the flavor) was the
+    # one reset by the success
+    assert instances[0].resets >= 1
+
+
+def test_shutdown_mid_flight_closes_engines():
+    queue = ScriptQueue([make_chunk])
+    engines = []
+    run_worker(queue, listing_factory(engines, [OkEngine]))
+    # the final pull reported the completed chunk, then ShuttingDown
+    assert queue.reports[-1] == ["fake-response"]
+    assert all(e.closed for e in engines)
+
+
+def test_backoff_pending_accessor():
+    b = RandomizedBackoff()
+    assert not b.pending()
+    b.next()
+    assert b.pending()
+    b.reset()
+    assert not b.pending()

@@ -1,0 +1,144 @@
+"""Replay named fault scripts against a live engine supervisor.
+
+Manual soak/chaos harness for the supervisor (engine/supervisor.py):
+spins up a SupervisedEngine over the scriptable fake host
+(engine/fakehost.py), feeds it synthetic analysis chunks, and prints
+per-chunk outcomes plus the final SupervisorStats. The same scripts run
+in tier-1 (tests/test_supervisor.py); this tool is for watching the
+watchdog work in real time and for soak-testing timing knobs.
+
+Examples:
+    python -m tools.chaos --script flap --chunks 6 --breaker-threshold 2 \
+        --probe-interval 2
+    python -m tools.chaos --script hang --chunk-ttl 3
+    python -m tools.chaos --script '{"chunks": ["stall", "ok"]}' --chunks 3
+    python -m tools.chaos --list
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from fishnet_tpu.client.ipc import Chunk, WorkPosition  # noqa: E402
+from fishnet_tpu.client.logger import Logger  # noqa: E402
+from fishnet_tpu.client.wire import (  # noqa: E402
+    AnalysisWork,
+    EngineFlavor,
+    NodeLimit,
+)
+from fishnet_tpu.engine.base import EngineError  # noqa: E402
+from fishnet_tpu.engine.fakehost import NAMED_SCRIPTS  # noqa: E402
+from fishnet_tpu.engine.supervisor import SupervisedEngine  # noqa: E402
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def make_chunk(index: int, ttl: float, n_positions: int) -> Chunk:
+    work = AnalysisWork(
+        id=f"chaos{index:03d}",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=ttl, depth=1, multipv=None,
+    )
+    return Chunk(
+        work=work, deadline=time.monotonic() + ttl, variant="standard",
+        flavor=EngineFlavor.TPU,
+        positions=[
+            WorkPosition(work=work, position_index=i, url=None, skip=False,
+                         root_fen=START, moves=[])
+            for i in range(n_positions)
+        ],
+    )
+
+
+async def replay(args) -> int:
+    state = tempfile.NamedTemporaryFile(
+        prefix="chaos-state-", suffix=".json", delete=False
+    )
+    state.close()
+    host_cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", args.script,
+        "--state", state.name,
+        "--hb-interval", str(args.hb_interval),
+    ]
+    sup = SupervisedEngine(
+        host_cmd,
+        logger=Logger(verbose=2),
+        hb_interval=args.hb_interval,
+        hb_timeout=args.hb_timeout,
+        breaker_threshold=args.breaker_threshold,
+        probe_interval=args.probe_interval,
+    )
+    failures = 0
+    try:
+        for i in range(args.chunks):
+            chunk = make_chunk(i, args.chunk_ttl, args.positions)
+            t0 = time.monotonic()
+            try:
+                responses = await sup.go_multiple(chunk)
+            except EngineError as e:
+                failures += 1
+                print(f"chunk {i}: ChunkFailed after "
+                      f"{time.monotonic() - t0:.2f}s — {e}")
+            else:
+                cp = responses[0].scores.best()
+                src = ("fake host" if cp is not None and cp.value == 777
+                       else "cpu fallback")
+                print(f"chunk {i}: ok in {time.monotonic() - t0:.2f}s "
+                      f"({len(responses)} responses via {src})")
+            if args.pause:
+                await asyncio.sleep(args.pause)
+    finally:
+        await sup.close()
+        Path(state.name).unlink(missing_ok=True)
+    s = sup.stats
+    print(
+        f"\nstats: spawns={s.spawns} deaths={s.deaths} kills={s.kills} "
+        f"hb_stalls={s.hb_stalls} deadline_kills={s.deadline_kills} "
+        f"protocol_errors={s.protocol_errors} breaker_trips={s.breaker_trips} "
+        f"breaker_resets={s.breaker_resets} probes={s.probes} "
+        f"fallback_chunks={s.fallback_chunks} chunks_ok={s.chunks_ok}"
+    )
+    print(f"chunks: {args.chunks - failures} served, {failures} failed")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="chaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--script", default="flap",
+                   help="named script, inline JSON, or @path "
+                        "(see --list; default: flap)")
+    p.add_argument("--list", action="store_true",
+                   help="list named fault scripts and exit")
+    p.add_argument("--chunks", type=int, default=4,
+                   help="number of chunks to feed (default 4)")
+    p.add_argument("--positions", type=int, default=2,
+                   help="positions per chunk (default 2)")
+    p.add_argument("--chunk-ttl", type=float, default=10.0,
+                   help="per-chunk deadline in seconds (default 10)")
+    p.add_argument("--pause", type=float, default=0.0,
+                   help="seconds to sleep between chunks (default 0)")
+    p.add_argument("--hb-interval", type=float, default=0.25)
+    p.add_argument("--hb-timeout", type=float, default=2.0)
+    p.add_argument("--breaker-threshold", type=int, default=3)
+    p.add_argument("--probe-interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+    if args.list:
+        for name, script in NAMED_SCRIPTS.items():
+            print(f"{name:12s} {json.dumps(script)}")
+        return 0
+    return asyncio.run(replay(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
