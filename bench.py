@@ -157,14 +157,44 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     if bench_dtype in ("bf16", "bfloat16"):
         params = nnue.cast_params(params, jnp.bfloat16)
     elif bench_dtype == "int8":
-        params = nnue.quantize_int8(params)
+        # retired after round 5 measured it at 37.2 knps vs 58-95 knps f32
+        # (docs/profile-r5.md) — the engine gates the same path behind
+        # FISHNET_TPU_EXPERIMENTAL_INT8 now; fail loudly rather than
+        # record a number for a config production refuses to run
+        raise RuntimeError("BENCH_DTYPE=int8 retired: measured slower than f32")
     elif bench_dtype not in ("", "f32", "float32"):
         # a typo'd dtype must not silently record an f32 run under the
         # wrong label — these artifacts are the round's perf record
         raise RuntimeError(f"unknown BENCH_DTYPE {bench_dtype!r}")
     max_ply = int(os.environ.get("BENCH_MAX_PLY", str(depth + 1)))
-    depth_arr = jnp.full((B,), depth, jnp.int32)
-    budget_arr = jnp.full((B,), budget, jnp.int32)
+    # BENCH_HELPERS=K > 1: Lazy-SMP layout. The B fen-set lanes become the
+    # PRIMARIES (rows [0, B)); K-1 replica blocks follow, so helper row
+    # h*B + j re-searches primary j's root with perturbed move ordering
+    # (ops/search.py order_jitter), sharing work only through the TT.
+    # positions_done_per_s counts primaries only — helpers are the means,
+    # not the deliverable — while nps keeps counting every lane (it is a
+    # machine-throughput number).
+    helpers = max(1, int(os.environ.get("BENCH_HELPERS", "1")))
+    Bt = B * helpers
+    order_jitter = None
+    group = None
+    required = None
+    if helpers > 1:
+        roots = jax.tree.map(
+            lambda a: jnp.concatenate([a] * helpers, axis=0), roots)
+        jit_arr = np.zeros(Bt, np.int32)
+        grp_arr = np.arange(Bt, dtype=np.int32) % B
+        for h in range(1, helpers):
+            for j in range(B):
+                jit_arr[h * B + j] = j * helpers + h  # nonzero ⇔ helper
+        order_jitter = jnp.asarray(jit_arr)
+        group = jnp.asarray(grp_arr)
+        required = np.zeros(Bt, bool)
+        required[:B] = True  # stop the moment every primary is DONE
+    depth_arr = jnp.full((Bt,), depth, jnp.int32)
+    budget_arr = jnp.full((Bt,), budget, jnp.int32)
+    prefer_deep = helpers > 1
+    tt_gen = 1 if helpers > 1 else 0
 
     # optional shared transposition table (BENCH_TT_LOG2=21 etc.); off by
     # default so the metric stays a raw search-throughput number
@@ -179,7 +209,10 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # compile each program explicitly so a compiler hang is distinguishable
     # from an execution hang in the heartbeat tail
     _hb(t0, "compile_start init_state")
-    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply, variant)
+    state = S._init_state_jit(
+        params, roots, depth_arr, budget_arr, max_ply, variant,
+        order_jitter=order_jitter, group=group,
+    )
     jax.block_until_ready(state.bt)
     _hb(t0, "compile_done init_state (and executed)")
     # short segments let the lane-narrowing path retire finished lanes
@@ -188,7 +221,15 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # narrowing checkpoint and the finish-tail eats ~60% of wall clock
     seg = int(os.environ.get("BENCH_SEG", "1024"))
     _hb(t0, f"compile_start run_segment(seg={seg})")
-    lowered = S._run_segment_jit.lower(params, state, tt, seg, variant)
+    # the trailing args (deep_tt, prefer_deep, tt_gen) must mirror the
+    # timed search_batch_resumable call exactly — tt_gen is a TRACED
+    # operand, so even its weak-vs-strong int32 typing must match or
+    # this precompile misses and a cold XLA compile lands in the timed
+    # region
+    lowered = S._run_segment_jit.lower(
+        params, state, tt, seg, variant, False, prefer_deep,
+        jnp.int32(tt_gen),
+    )
     _hb(t0, "  lowered")
     lowered.compile()
     _hb(t0, "compile_done run_segment")
@@ -198,19 +239,26 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # inside the timed region would corrupt the recorded nps. Narrowing
     # targets are powers of two >= 64 (ops/search.py), regardless of B.
     w = 64
-    while w * 2 < B:
+    while w * 2 < Bt:
         w *= 2
     while w >= 64:
         sub = jax.tree.map(lambda a: a[:w], state)
         _hb(t0, f"compile_start run_segment(width={w})")
-        S._run_segment_jit.lower(params, sub, tt, seg, variant).compile()
+        S._run_segment_jit.lower(
+            params, sub, tt, seg, variant, False, prefer_deep,
+            jnp.int32(tt_gen),
+        ).compile()
         w //= 2
     _hb(t0, "compile_done narrowed widths")
 
+    helper_kw = dict(
+        order_jitter=order_jitter, group=group, required=required,
+        prefer_deep_store=prefer_deep, tt_gen=tt_gen,
+    )
     _hb(t0, "exec_start warmup search")
     out = S.search_batch_resumable(
         params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt, variant=variant,
+        segment_steps=seg, tt=tt, variant=variant, **helper_kw,
     )
     tt = out.pop("tt")
     jax.block_until_ready(out["nodes"])
@@ -220,12 +268,13 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     t1 = time.perf_counter()
     out = S.search_batch_resumable(
         params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt, variant=variant,
+        segment_steps=seg, tt=tt, variant=variant, **helper_kw,
     )
     out.pop("tt")
     jax.block_until_ready(out["nodes"])
     dt = time.perf_counter() - t1
     total_nodes = int(np.asarray(out["nodes"]).sum())
+    primary_nodes = int(np.asarray(out["nodes"])[:B].sum())
     _hb(t0, f"exec_done timed: {total_nodes:,} nodes in {dt:.2f}s")
 
     print(
@@ -246,9 +295,13 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                     else "select"
                 ),
                 "max_ply": max_ply,
+                # primaries only: with helpers the first B rows are the
+                # analysed positions; helper completions are not output
                 "positions_done_per_s": round(
-                    float(np.asarray(out["done"]).sum()) / dt, 1
+                    float(np.asarray(out["done"])[:B].sum()) / dt, 1
                 ),
+                "helpers": helpers,
+                "primary_nodes": primary_nodes,
                 "net": os.environ.get("BENCH_NET", "random"),
                 "dtype": bench_dtype or "f32",
                 "tt_log2": tt_log2,
@@ -398,8 +451,9 @@ def main() -> None:
             ("cfg5_threecheck", 64, 3, "threeCheck", "variant", None),
             ("dtype_bf16", 64, 3, "standard", "standard",
              {"BENCH_DTYPE": "bf16"}),
-            ("dtype_int8", 64, 3, "standard", "standard",
-             {"BENCH_DTYPE": "int8"}),
+            # dtype_int8 row retired: round 5 measured 37.2 knps vs
+            # 58-95 knps f32, and the engine now gates the int8 path
+            # behind FISHNET_TPU_EXPERIMENTAL_INT8 (it is a net loss)
             # multipv fen_set: DISTINCT positions per lane — repeating the
             # 8 standard FENs across lanes lets the shared TT dedup whole
             # subtrees, which deflates the nodes/sec metric while doing
@@ -409,6 +463,14 @@ def main() -> None:
             ("production_d6_mp32", 192, 6, "standard", "multipv",
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
               "BENCH_TT_LOG2": "21"}),
+            # same production shape with 3 Lazy-SMP helper lanes riding
+            # each of the 192 primaries (768 lanes total, shared 2M-slot
+            # TT): the acceptance comparison is this row's
+            # positions_done_per_s and completed depth vs
+            # production_d6_mp32 at the same deadline
+            ("helper_lanes_k4", 192, 6, "standard", "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_HELPERS": "4"}),
         ]
         for name, b, d, var, fset, xenv in cfg_stages:
             remaining = total_budget - (time.time() - t_start)

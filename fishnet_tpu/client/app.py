@@ -66,6 +66,7 @@ def make_engine_factory(cfg: Config, logger: Logger):
                         backend="tpu",
                         weights_path=cfg.tpu_weights,
                         max_depth=cfg.tpu_depth,
+                        helper_lanes=cfg.tpu_helpers,
                         logger=logger,
                     )
                 else:
@@ -74,6 +75,7 @@ def make_engine_factory(cfg: Config, logger: Logger):
                     tpu_engine = TpuEngine(
                         weights_path=cfg.tpu_weights,
                         max_depth=cfg.tpu_depth,
+                        helper_lanes=cfg.tpu_helpers,
                         logger=logger,
                     )
             # one device program (or supervised child) shared by all

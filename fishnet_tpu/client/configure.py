@@ -92,6 +92,10 @@ class Config:
     # while the deadline race still cuts off any iteration a slow
     # backend can't afford.
     tpu_depth: int = 12
+    # Lazy-SMP helper lanes per analysed position (engine/tpu.py): spare
+    # batch lanes re-search the hardest roots with perturbed ordering and
+    # share results through the TT. 1 disables helpers entirely.
+    tpu_helpers: int = 4
     # host the TPU engine in a supervised child process (engine/supervisor.py)
     # so a wedged device can be hard-killed and respawned; --no-supervisor
     # reverts to the in-process engine (debugging, single-process profiling)
@@ -138,6 +142,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu-weights",
                    help="NNUE weights: our .npz or a Stockfish .nnue file")
     p.add_argument("--tpu-depth", type=int, help="max search depth for the TPU engine")
+    p.add_argument("--tpu-helpers", type=int,
+                   help="Lazy-SMP helper lanes per position (1 disables)")
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the TPU engine in-process instead of in a "
                         "supervised child process")
@@ -203,6 +209,7 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.variant_engine_path = pick(args.variant_engine_path, "variant_engine_path")
     cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
     cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", Config.tpu_depth))
+    cfg.tpu_helpers = int(pick(args.tpu_helpers, "tpu_helpers", Config.tpu_helpers))
     supervisor_ini = str(ini.get("supervisor", "")).strip().lower()
     cfg.supervisor = not (
         args.no_supervisor or supervisor_ini in ("0", "false", "no", "off")

@@ -52,6 +52,7 @@ def _build_engine(args, log):
     engine = TpuEngine(
         weights_path=args.weights or None,
         max_depth=args.depth or 12,
+        helper_lanes=args.helpers,
     )
     if not args.skip_warmup:
         engine.warmup(None, log)
@@ -69,6 +70,9 @@ def main(argv=None) -> int:
     p.add_argument("--backend", choices=["tpu", "py"], default="tpu")
     p.add_argument("--weights", default=None)
     p.add_argument("--depth", type=int, default=None)
+    # Lazy-SMP lanes per analysed position (engine/tpu.py helper_lanes);
+    # None defers to FISHNET_TPU_HELPERS / the engine default, 1 disables
+    p.add_argument("--helpers", type=int, default=None)
     p.add_argument("--hb-interval", type=float, default=1.0)
     p.add_argument("--skip-warmup", action="store_true")
     args = p.parse_args(argv)

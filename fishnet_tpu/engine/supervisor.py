@@ -56,6 +56,7 @@ def default_host_cmd(
     weights: Optional[str] = None,
     depth: Optional[int] = None,
     hb_interval: float = 1.0,
+    helpers: Optional[int] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "fishnet_tpu.engine.host",
@@ -65,6 +66,9 @@ def default_host_cmd(
         cmd += ["--weights", str(weights)]
     if depth is not None:
         cmd += ["--depth", str(depth)]
+    if helpers is not None:
+        # Lazy-SMP lane groups (engine/tpu.py helper_lanes); 1 disables
+        cmd += ["--helpers", str(helpers)]
     return cmd
 
 
@@ -106,6 +110,7 @@ class SupervisedEngine:
         backend: str = "tpu",
         weights_path: Optional[str] = None,
         max_depth: Optional[int] = None,
+        helper_lanes: Optional[int] = None,
         logger: Optional[Logger] = None,
         hb_interval: float = 1.0,
         hb_timeout: Optional[float] = None,
@@ -119,7 +124,7 @@ class SupervisedEngine:
     ) -> None:
         self.host_cmd = host_cmd or default_host_cmd(
             backend=backend, weights=weights_path, depth=max_depth,
-            hb_interval=hb_interval,
+            hb_interval=hb_interval, helpers=helper_lanes,
         )
         self.logger = logger or Logger()
         self.hb_interval = hb_interval

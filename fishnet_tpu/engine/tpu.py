@@ -42,6 +42,21 @@ MAX_PLY = int(os.environ.get("FISHNET_TPU_MAX_PLY", "32"))
 # root-move lanes. Fewer buckets = fewer cold XLA compiles to warm up.
 LANE_BUCKETS = (16, 64, 128, 256)
 
+# aspiration window half-widths tried in order by _search_windowed (the
+# final full-width attempt is implicit). Measured on the standard 8-FEN
+# set at depth 5 via aspiration_stats (docs/depth.md §"Aspiration
+# deltas, measured"): (15, 120) searched the fewest total nodes of the
+# six schedules tried — a narrow first rung fails ~2/3 of the time but
+# the windowed tree it cuts outweighs the re-searches, and the 120 rung
+# catches 90% of the escapees. The old hardcoded (30, 200) measured ~5%
+# more nodes; wider schedules up to (60, 250) measured ~9-14% more.
+_asp_env = os.environ.get("FISHNET_TPU_ASPIRATION")
+ASPIRATION_DELTAS = (
+    tuple(int(x) for x in _asp_env.split(",") if x)
+    if _asp_env
+    else (15, 120)
+)
+
 
 def _decode_uci(m: int) -> str:
     frm, to, promo = m & 63, (m >> 6) & 63, (m >> 12) & 7
@@ -129,6 +144,7 @@ class TpuEngine:
         seed: int = 1234,
         tt_size_log2: int = 21,  # 2M slots ≈ 24 MiB HBM; 0 disables
         max_lanes: Optional[int] = None,  # single-dispatch lane ceiling
+        helper_lanes: Optional[int] = None,  # Lazy-SMP lanes per position (K)
         logger=None,  # client Logger for operational warnings; stderr if None
     ) -> None:
         from ..utils import enable_compile_cache
@@ -177,16 +193,31 @@ class TpuEngine:
                 params = nnue.init_params(
                     jax.random.PRNGKey(seed), l1=64, feature_set="board768"
                 )
+        self._logger = logger
         # FISHNET_TPU_DTYPE quantizes the weights (SURVEY §7.2):
-        # bf16 → MXU-native float inputs, f32 accumulators;
-        # int8 → fixed-point ladder, int8×int8→int32 dots, exact int32
-        # accumulators (nnue.quantize_int8)
+        # bf16 → MXU-native float inputs, f32 accumulators. The int8
+        # fixed-point ladder (nnue.quantize_int8) measured a NET LOSS at
+        # the production shape (round 5, bench_matrix.json dtype_int8:
+        # 37.2 knps vs 58-95 knps f32 — int32 dots keep the MXU idle),
+        # so it survives only as an experiment behind an extra flag.
         dtype_env = os.environ.get("FISHNET_TPU_DTYPE", "").lower()
         if dtype_env in ("bf16", "bfloat16"):
             params = nnue.cast_params(params, jnp.bfloat16)
         elif dtype_env == "int8":
-            if nnue.is_board768(params):
-                params = nnue.quantize_int8(params)
+            if os.environ.get("FISHNET_TPU_EXPERIMENTAL_INT8") == "1":
+                self._warn(
+                    "experimental int8 weights enabled: measured SLOWER "
+                    "than f32 at production shapes (37.2 vs 58-95 knps, "
+                    "round-5 bench)"
+                )
+                if nnue.is_board768(params):
+                    params = nnue.quantize_int8(params)
+            else:
+                self._warn(
+                    "FISHNET_TPU_DTYPE=int8 ignored: measured a net loss "
+                    "vs f32 (37.2 vs 58-95 knps); set "
+                    "FISHNET_TPU_EXPERIMENTAL_INT8=1 to run it anyway"
+                )
         self.params = params
         self.max_depth = max_depth
         # B=2048 falls off the VMEM cliff on v5e (docs/tpu-hang.md round 5:
@@ -198,7 +229,28 @@ class TpuEngine:
             if max_lanes is not None
             else int(os.environ.get("FISHNET_TPU_MAX_LANES", "1024"))
         )
-        self._logger = logger
+        # Lazy-SMP helper lanes (docs/profile-r5.md §"Batch completion of
+        # deep searches"): an analysed position may occupy up to K lanes —
+        # one PRIMARY whose score/PV is the reported result (oracle
+        # semantics intact), plus up to K-1 HELPERS searching the same
+        # root with jittered move ordering, staggered aspiration windows
+        # and +1-ply depth offsets, communicating only through the shared
+        # TT. K=1 disables the machinery entirely and is bit-identical to
+        # the pre-helper engine; no TT forces K=1 (helpers without the
+        # communication channel are pure waste).
+        if helper_lanes is None:
+            helper_lanes = int(os.environ.get("FISHNET_TPU_HELPERS", "4"))
+        self.helper_lanes = max(1, min(int(helper_lanes), 16))
+        if self.tt is None:
+            self.helper_lanes = 1
+        # TT generation counter, bumped per chunk: helper-mode stores
+        # carry it so depth-preferred replacement never protects stale
+        # entries from earlier chunks (ops/tt.py store)
+        self._tt_gen = 0
+        # per-delta aspiration accounting {delta: [windowed, fail_lo,
+        # fail_hi, nodes]} — the measured basis for ASPIRATION_DELTAS
+        # (see docs/depth.md §"Aspiration deltas, measured")
+        self.aspiration_stats: dict = {}
         # FISHNET_TPU_TRACE=1: per-dispatch / per-depth timing lines to
         # stderr (verdict A1: a hang or slow depth must be localizable
         # from logs — compile-vs-run shows up as a slow FIRST dispatch
@@ -250,8 +302,13 @@ class TpuEngine:
             b = self._pad(b)
             t0 = _time.monotonic()
             roots = stack_boards([from_position(Position.initial())] * b)
+            # with helper lanes enabled, every production analysis
+            # dispatch compiles the helper-mode program (prefer_deep
+            # stores are a static flag) — warm THAT variant, or the
+            # first chunk pays the cold compile anyway
             self._search(
-                roots, np.ones(b, np.int32), np.full(b, 64, np.int32)
+                roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
+                helper_store=self.helper_lanes > 1,
             )
             if log is not None:
                 log(
@@ -338,6 +395,9 @@ class TpuEngine:
                     roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
                     variant=variant, deep_tt=deep,
                     tt_override=scratch,
+                    # analysis dispatches run the helper-mode program
+                    # when helper lanes are on; move jobs stay plain
+                    helper_store=(not deep) and self.helper_lanes > 1,
                 )
                 if log is not None:
                     log(
@@ -380,12 +440,20 @@ class TpuEngine:
 
     def _search(self, roots, depth_arr, budget_arr, deadline=None,
                 variant="standard", hist=None, window=None,
-                deep_tt=False, tt_override=None):
+                deep_tt=False, tt_override=None, order_jitter=None,
+                group=None, required=None, helper_store=False):
         # the TT is shared across variants: variant state is hashed into
         # the key (ops/tt.py), so entries can't collide across rule sets.
         # tt_override: search against a caller-owned table (warmup
         # scratch) and leave self.tt alone — such calls don't need the
         # engine lock.
+        # order_jitter/group/required: Lazy-SMP lane-group layout (see
+        # search_batch_resumable); helper_store switches TT stores to the
+        # depth-preferred generation-aware policy. helper_store is a
+        # STATIC compile flag: it is set for ALL analysis dispatches
+        # whenever helper lanes are enabled (multipv groups too, which
+        # benefit from the same shallow-write protection) so warmup
+        # compiles exactly one program per bucket either way.
         t0 = time.monotonic()
         out = search_batch_resumable(
             self.params, roots, jnp.asarray(depth_arr),
@@ -394,6 +462,9 @@ class TpuEngine:
             tt=self.tt if tt_override is None else tt_override,
             mesh=self.mesh,
             variant=variant, hist=hist, window=window, deep_tt=deep_tt,
+            order_jitter=order_jitter, group=group, required=required,
+            prefer_deep_store=helper_store,
+            tt_gen=self._tt_gen if helper_store else 0,
             # deep_tt = move jobs: their narrowed widths would be
             # deep-bounds programs warmup never compiled, and a cold XLA
             # compile inside the 7 s move deadline loses the job. Their
@@ -420,14 +491,33 @@ class TpuEngine:
         return out
 
     def _search_windowed(self, roots, depth_arr, budget_arr, deadline,
-                         variant, hist, prev_score, use_win):
+                         variant, hist, prev_score, use_win,
+                         required=None, win_scale=None, order_jitter=None,
+                         group=None, helper_store=False):
         """Aspiration-windowed dispatch (classic iterative-deepening win:
         a narrow window around the previous depth's score cuts most of
         the tree; a fail-low/high re-searches wider, settled lanes ride
         along at depth 0 / budget 1). Returns the merged result dict with
-        per-lane nodes summed over attempts."""
+        per-lane nodes summed over attempts.
+
+        Helper-lane extensions: `required` marks the primary lanes —
+        only THEIR fail-low/high triggers a re-search (a helper failing
+        its window costs nothing; its TT entries already landed), and
+        the dispatch stops once all primaries finish. `win_scale` widens
+        each lane's delta (staggered helper windows: a helper searching
+        a wider window than its primary fails less and seeds EXACT
+        entries the primary's re-search can use). Helpers ride along on
+        the FIRST attempt only — re-search attempts are primary-only."""
         B = int(depth_arr.shape[0])
-        deltas = (30, 200, None)  # None = full window
+        deltas = ASPIRATION_DELTAS + (None,)  # None = full window
+        primary = (
+            np.ones(B, bool) if required is None
+            else np.asarray(required, bool)
+        )
+        scale = (
+            np.ones(B, np.int64) if win_scale is None
+            else np.asarray(win_scale, np.int64)
+        )
         merged = None
         nodes_acc = np.zeros(B, np.int64)
         live = np.ones(B, bool)
@@ -437,14 +527,22 @@ class TpuEngine:
                 alpha_w = np.full(B, -INF, np.int32)
                 beta_w = np.full(B, INF, np.int32)
             else:
-                alpha_w = np.where(use_win, prev_score - delta, -INF).astype(np.int32)
-                beta_w = np.where(use_win, prev_score + delta, INF).astype(np.int32)
+                # clip into [-INF, INF]: a clipped-to-INF bound reads as
+                # no-window on that side (the fail checks below exclude it)
+                alpha_w = np.where(
+                    use_win, np.maximum(prev_score - delta * scale, -INF), -INF
+                ).astype(np.int32)
+                beta_w = np.where(
+                    use_win, np.minimum(prev_score + delta * scale, INF), INF
+                ).astype(np.int32)
             out = self._search(
                 roots,
                 np.where(live, depth_arr, 0).astype(np.int32),
                 np.where(live, budget_arr, 1).astype(np.int32),
                 deadline, variant=variant, hist=hist,
                 window=(alpha_w, beta_w),
+                order_jitter=order_jitter, group=group,
+                required=required, helper_store=helper_store,
             )
             if merged is None:
                 merged = {k: np.array(v) for k, v in out.items()}
@@ -453,15 +551,28 @@ class TpuEngine:
                     merged[k][live] = out[k][live]
             nodes_acc[live] += out["nodes"][live]
             score = out["score"]
-            fail_lo = live & out["done"] & (score <= alpha_w) & (alpha_w > -INF)
-            fail_hi = live & out["done"] & (score >= beta_w) & (beta_w < INF)
+            fail_lo = (
+                live & primary & out["done"]
+                & (score <= alpha_w) & (alpha_w > -INF)
+            )
+            fail_hi = (
+                live & primary & out["done"]
+                & (score >= beta_w) & (beta_w < INF)
+            )
             fail = fail_lo | fail_hi
+            if delta is not None and use_win.any():
+                st = self.aspiration_stats.setdefault(delta, [0, 0, 0, 0])
+                st[0] += int((use_win & live & primary).sum())
+                st[1] += int(fail_lo.sum())
+                st[2] += int(fail_hi.sum())
+                st[3] += int(out["nodes"][live].sum())
             if self.trace and delta is not None and use_win.any():
                 # aspiration economics (round-3 verdict: window deltas
                 # were guesses with no recorded fail rates or costs)
                 self.trace(
                     f"aspiration delta={delta}: windowed="
-                    f"{int((use_win & live).sum())} fail_lo={int(fail_lo.sum())} "
+                    f"{int((use_win & live & primary).sum())} "
+                    f"fail_lo={int(fail_lo.sum())} "
                     f"fail_hi={int(fail_hi.sum())} "
                     f"nodes={int(out['nodes'][live].sum())}"
                 )
@@ -476,6 +587,50 @@ class TpuEngine:
                 break
         merged["nodes"] = nodes_acc
         return merged
+
+    @staticmethod
+    def _plan_helpers(n_primary: int, B: int, k_max: int, hardness):
+        """Allocate the dispatch's spare lanes as helpers, hardest
+        positions first: → list of (primary_row, helper_index) with
+        helper_index 1..k_max-1, at most k_max-1 helpers per primary,
+        at most B - n_primary total. Round-robin in descending-hardness
+        order, so every hard position gets its first helper before any
+        gets its second. hardness[j] <= 0 excludes primary j (settled,
+        terminal, or budget-exhausted lanes get no helpers)."""
+        spare = B - n_primary
+        out: list = []
+        if k_max <= 1 or spare <= 0 or n_primary <= 0:
+            return out
+        hardness = [int(h) for h in hardness]
+        order = sorted(range(n_primary), key=lambda r: (-hardness[r], r))
+        grants = [0] * n_primary
+        while len(out) < spare:
+            progressed = False
+            for r in order:
+                if len(out) >= spare:
+                    break
+                if hardness[r] > 0 and grants[r] < k_max - 1:
+                    grants[r] += 1
+                    out.append((r, grants[r]))
+                    progressed = True
+            if not progressed:
+                break
+        return out
+
+    def _helper_width(self, n: int) -> int:
+        """Dispatch width for n primaries with helper lanes enabled: grow
+        the lane bucket toward n*K so the planner has spare rows to fill
+        (a wider lockstep program costs nearly the same per step on TPU —
+        docs/depth.md us/step tables — and the narrowing floor is 64
+        anyway), but never above the device ceiling. K=1 keeps the
+        pre-helper width exactly."""
+        B = self._pad(n)
+        K = self.helper_lanes
+        if K > 1:
+            grown = self._pad(min(n * K, self.max_lanes))
+            if grown <= max(self.max_lanes, B):
+                B = max(B, grown)
+        return B
 
     @staticmethod
     def _history_arrays(hist_lists, B, variant="standard", keep_last=0):
@@ -550,6 +705,10 @@ class TpuEngine:
 
     def _go_multiple_locked(self, chunk: Chunk) -> List[PositionResponse]:
         started = time.monotonic()
+        # one TT generation per chunk: helper-mode stores from THIS chunk
+        # out-rank each other by depth but always replace earlier chunks'
+        # entries (ops/tt.py store; wraps long before int32 overflow)
+        self._tt_gen = (self._tt_gen + 1) & 0x3FFFFFFF
         positions = []
         games = []  # per position: the replayed game prefix (oldest first)
         for wp in chunk.positions:
@@ -701,34 +860,98 @@ class TpuEngine:
         nodes_total = [0] * len(positions)
 
         if lanes:
-            B = self._pad(len(lanes))
+            n = len(lanes)
+            K = self.helper_lanes
+            B = self._helper_width(n)
             boards = [from_position(positions[i]) for i in lanes]
-            pad = from_position(positions[lanes[0]])
-            roots = stack_boards(boards + [pad] * (B - len(boards)))
+            pad_board = boards[0]
             variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
-            hist = self._history_arrays([games[i] for i in lanes], B, variant)
+            hist_hh, hist_hm = self._history_arrays(
+                [games[i] for i in lanes], B, variant
+            )
             per_pos_budget = budget if budget is not None else 10_000_000
-            remaining = np.full(B, per_pos_budget, dtype=np.int64)
-            prev_score = np.zeros(B, np.int64)
-            have_prev = np.zeros(B, bool)
+            # primary-indexed iterative-deepening state (length n)
+            remaining = np.full(n, per_pos_budget, dtype=np.int64)
+            prev_score = np.zeros(n, np.int64)
+            have_prev = np.zeros(n, bool)
+            # hardness drives the helper planner: the previous depth's
+            # primary node count — the lane that took the most serial
+            # work is the one bounding the next depth's lockstep wall
+            hardness = np.ones(n, np.int64)
 
             deadline = chunk.deadline - 0.25  # leave slack to package results
             for depth in range(1, target_depth + 1):
+                # ---- lane-group layout for this depth: primaries in
+                # rows 0..n-1, helpers next, inert padding after. Helper
+                # h of primary j searches j's root with jittered move
+                # ordering; odd helpers at the SAME depth (their exact-
+                # depth TT entries are consumable THIS iteration — probe
+                # requires exact depth, ops/tt.py), even helpers one ply
+                # DEEPER (their entries feed ordering now and cutoffs
+                # next iteration). All are abandoned mid-flight the
+                # moment every primary finishes (required mask).
+                helpers = (
+                    self._plan_helpers(
+                        n, B, K, np.where(remaining > 0, hardness, 0)
+                    )
+                    if K > 1
+                    else []
+                )
+                roots = stack_boards(
+                    boards
+                    + [boards[j] for j, _h in helpers]
+                    + [pad_board] * (B - n - len(helpers))
+                )
                 depth_arr = np.zeros(B, np.int32)
-                depth_arr[: len(lanes)] = depth
-                budget_arr = np.clip(remaining, 0, 2**31 - 1).astype(np.int32)
-                use_win = (
+                depth_arr[:n] = depth
+                budget_arr = np.ones(B, np.int32)
+                budget_arr[:n] = np.clip(remaining, 0, 2**31 - 1)
+                use_full = np.zeros(B, bool)
+                use_full[:n] = (
                     have_prev & (np.abs(prev_score) < MATE - 1000)
                     & (depth >= 2)
                 )
+                prev_full = np.zeros(B, np.int64)
+                prev_full[:n] = prev_score
+                if K > 1:
+                    hh = hist_hh.copy()
+                    hm = hist_hm.copy()
+                    jitter = np.zeros(B, np.int32)
+                    grp = np.arange(B, dtype=np.int32)
+                    scale_arr = np.ones(B, np.int64)
+                    req = np.zeros(B, bool)
+                    req[:n] = True
+                    for idx, (j, h) in enumerate(helpers):
+                        r = n + idx
+                        hh[r] = hist_hh[j]
+                        hm[r] = hist_hm[j]
+                        # same depth for odd h, +1 ply for even h
+                        depth_arr[r] = min(depth + (1 - (h & 1)), target_depth)
+                        budget_arr[r] = budget_arr[j]
+                        jitter[r] = j * K + h  # != 0, unique per (j, h)
+                        grp[r] = j
+                        scale_arr[r] = 1 << min(h, 4)  # staggered windows
+                        use_full[r] = use_full[j]
+                        prev_full[r] = prev_score[j]
+                    hist_args = dict(
+                        required=req, win_scale=scale_arr,
+                        order_jitter=jitter, group=grp, helper_store=True,
+                    )
+                    hist_d = (hh, hm)
+                else:
+                    # K=1: identical arguments (and compiled programs) to
+                    # the pre-helper engine — bit-for-bit the same search
+                    hist_args = {}
+                    hist_d = (hist_hh, hist_hm)
                 t_depth = time.monotonic()
                 out = self._search_windowed(
                     roots, depth_arr, budget_arr, deadline,
-                    variant, hist, prev_score, use_win,
+                    variant, hist_d, prev_full, use_full, **hist_args,
                 )
                 if self.trace:
                     self.trace(
-                        f"ID depth={depth} B={B} lanes={len(lanes)} "
+                        f"ID depth={depth} B={B} lanes={n} "
+                        f"helpers={len(helpers)} "
                         f"nodes={int(out['nodes'].sum())} "
                         f"wall={time.monotonic() - t_depth:.3f}s"
                     )
@@ -736,8 +959,20 @@ class TpuEngine:
                 for j, i in enumerate(lanes):
                     if remaining[j] <= 0 or not bool(out["done"][j]):
                         continue  # lane skipped, or stopped mid-depth on deadline
-                    nodes_total[i] += int(out["nodes"][j])
-                    remaining[j] -= int(out["nodes"][j])
+                    # helper nodes are charged to their primary: the
+                    # position consumed that work against its server
+                    # budget (same honesty rule as multipv's root-move
+                    # lanes; helpers are abandoned at primary completion,
+                    # so the charge is the work actually spent)
+                    lane_nodes = int(out["nodes"][j])
+                    help_nodes = sum(
+                        int(out["nodes"][n + idx])
+                        for idx, (jj, _h) in enumerate(helpers)
+                        if jj == j
+                    )
+                    hardness[j] = max(lane_nodes, 1)
+                    nodes_total[i] += lane_nodes + help_nodes
+                    remaining[j] -= lane_nodes + help_nodes
                     sc = int(out["score"][j])
                     prev_score[j] = sc
                     have_prev[j] = True
@@ -945,6 +1180,11 @@ class TpuEngine:
                 out = self._search(
                     roots, depth_arr, budget_arr, deadline,
                     variant=variant, hist=hist,
+                    # root-move lanes already fill the dispatch, so no
+                    # helper replication here — but the depth-preferred
+                    # store policy still applies (and keeps the compiled
+                    # program identical to the warmed helper-mode one)
+                    helper_store=self.helper_lanes > 1,
                 )
                 done = out["done"]
                 # fold lanes back per position

@@ -96,6 +96,13 @@ BT_W = 96
 # lane fields
 (LN_PLY, LN_MODE, LN_RET, LN_RETD, LN_SMARK, LN_SVAL, LN_NODES, LN_DLIM,
  LN_BUDGET, LN_RSCORE, LN_RMOVE, LN_RALPHA, LN_RBETA, LN_RESEARCH) = range(14)
+# lane-group metadata (Lazy-SMP helper lanes, engine/tpu.py): the lane's
+# ordering-jitter seed (0 = primary / unperturbed) and its group id (the
+# original lane index of the primary whose root it replicates). Carried
+# for debugging/extraction; the jitter's effect is baked into the
+# initial history table by init_state.
+LN_JITTER = 14
+LN_GROUP = 15
 LN_W = 16
 
 # nt fields ENTER initializes on node expansion vs on every entry: a
@@ -210,14 +217,24 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
                node_budget: jnp.ndarray, max_ply: int,
                variant: str = "standard",
                hist_hash=None, hist_halfmove=None,
-               root_alpha=None, root_beta=None) -> SearchState:
+               root_alpha=None, root_beta=None,
+               order_jitter=None, group=None) -> SearchState:
     """roots: batched Board (B leading dim); depth/node_budget: (B,).
 
     hist_hash (B, MAX_HIST, 2) / hist_halfmove (B, MAX_HIST): optional
     reversible game-history tail per lane (see MAX_HIST above); None
     seeds the sentinel (no pre-root repetitions possible).
     root_alpha/root_beta (B,): optional aspiration window at the root
-    (host-side iterative deepening re-searches on fail-low/high)."""
+    (host-side iterative deepening re-searches on fail-low/high).
+    order_jitter (B,): optional per-lane move-ordering perturbation seed
+    for Lazy-SMP helper lanes. A lane with jitter j > 0 starts with
+    small pseudo-random history counters (hash-mixed from j), so its
+    quiet-move ordering breaks ties differently from every other lane of
+    its group — the lanes then explore the shared tree in different
+    orders and feed each other TT entries. Jitter 0 seeds exact zeros:
+    a jitter-0 lane is bit-identical to one searched without the
+    argument. group (B,): opaque per-lane group tag (stored, unused by
+    the search itself)."""
     B = roots.stm.shape[0]
     P = max_ply
     l1 = params.ft_w.shape[1]
@@ -263,6 +280,30 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         jnp.full((B,), INF, jnp.int32) if root_beta is None
         else jnp.asarray(root_beta, jnp.int32)
     )
+    if order_jitter is not None:
+        lane = lane.at[:, LN_JITTER].set(jnp.asarray(order_jitter, jnp.int32))
+    if group is not None:
+        lane = lane.at[:, LN_GROUP].set(jnp.asarray(group, jnp.int32))
+
+    hist0 = jnp.zeros((B, 4096), jnp.int32)
+    if order_jitter is not None:
+        # jittered lanes start from small (0..255) pseudo-random history
+        # counters instead of zeros, and exactly zero where jitter == 0.
+        # The range matters: move ordering reads hist >> 5 (movegen.py
+        # hbonus), so seeds below 32 would be invisible — 0..255 yields
+        # ordering bonuses of 0..7 key units, enough to reorder the
+        # equal-history quiet tail, while sustained real cutoffs (dl²+1
+        # credit each, growing to 2^20) still dominate within a few
+        # fail-highs
+        j = jnp.asarray(order_jitter, jnp.int32).astype(jnp.uint32)
+        idx = jnp.arange(4096, dtype=jnp.uint32)
+        mix = (j[:, None] * jnp.uint32(2654435761)) ^ (
+            idx[None, :] * jnp.uint32(2246822519)
+        )
+        mix = mix ^ (mix >> 15)
+        hist0 = jnp.where(
+            (j > 0)[:, None], (mix & jnp.uint32(255)).astype(jnp.int32), hist0
+        )
 
     if hist_hash is None:
         hist_hash = jnp.zeros((B, MAX_HIST, 2), jnp.uint32)
@@ -273,7 +314,7 @@ def init_state(params: nnue.NnueParams, roots: Board, depth: jnp.ndarray,
         hist_hash=jnp.asarray(hist_hash, jnp.uint32),
         hist_halfmove=jnp.asarray(hist_halfmove, jnp.int32),
         moves=jnp.full((B, P, max_moves_for(variant)), -1, jnp.int32),
-        hist=jnp.zeros((B, 4096), jnp.int32),
+        hist=hist0,
         pv=jnp.full((B, P, P), -1, jnp.int32),
         acc=acc,
     )
@@ -764,7 +805,7 @@ def _step_lane(params: nnue.NnueParams, s: SearchState,
         nodes, lane[LN_DLIM], lane[LN_BUDGET],
         root_score, root_move, lane[LN_RALPHA], lane[LN_RBETA],
         research.astype(jnp.int32),
-        jnp.int32(0), jnp.int32(0),
+        lane[LN_JITTER], lane[LN_GROUP],
     ])
 
     return SearchState(
@@ -813,10 +854,14 @@ def _gather_ply(arr: jnp.ndarray, ply: jnp.ndarray) -> jnp.ndarray:
 
 def _run_segment(params: nnue.NnueParams, state: SearchState,
                  ttab, segment_steps: int, variant: str = "standard",
-                 deep_tt: bool = False):
+                 deep_tt: bool = False, prefer_deep: bool = False,
+                 tt_gen=0):
     """Advance all lanes ≤ segment_steps. ttab: shared tt.TTable or None.
     deep_tt (STATIC): accept deeper LOWER/UPPER TT entries as cutoffs
     (move-job strength mode — see ops/tt.py probe).
+    prefer_deep (STATIC) + tt_gen (traced): helper-lane dispatches store
+    under the depth-preferred generation-aware replacement policy
+    (ops/tt.py store) so helper writes don't evict primary-path entries.
 
     The TT lives OUTSIDE the vmap: each iteration first stores every lane
     parked in RETURN (its finished node's value), then probes every lane
@@ -824,6 +869,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
     into the vmapped step. Stores from one lane are visible to every
     other lane in the same iteration — the cross-lane sharing that makes
     one HBM table worth more than B private ones."""
+    gen_i = jnp.asarray(tt_gen, jnp.int32)
 
     if ttab is None:
         step = make_search_step(params, variant)
@@ -872,7 +918,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
             t = _tt_mod.store(
                 t, h1, h2, lane[:, LN_RET],
                 jnp.maximum(lane[:, LN_RETD], 0), flag, ntrow[:, NT_BMOVE],
-                store_mask,
+                store_mask, prefer_deep=prefer_deep, gen=gen_i,
             )
 
             # ---- probe lanes about to enter a node (mode == ENTER);
@@ -909,6 +955,7 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
                 t, h1, h2, sval, jnp.zeros_like(sval),
                 jnp.full_like(sval, _tt_mod.FLAG_EXACT),
                 jnp.full_like(sval, -1), s.lane[:, LN_SMARK] != 0,
+                prefer_deep=prefer_deep, gen=gen_i,
             )
             return s, t, i + 1
 
@@ -923,7 +970,8 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
 
 
 _run_segment_jit = jax.jit(
-    _run_segment, static_argnames=("segment_steps", "variant", "deep_tt")
+    _run_segment,
+    static_argnames=("segment_steps", "variant", "deep_tt", "prefer_deep"),
 )
 _init_state_jit = jax.jit(init_state, static_argnames=("max_ply", "variant"))
 
@@ -956,8 +1004,24 @@ def search_batch_resumable(
     window=None,
     deep_tt: bool = False,
     narrow: bool = True,
+    order_jitter=None,
+    group=None,
+    required=None,
+    prefer_deep_store: bool = False,
+    tt_gen: int = 0,
 ):
     """Like `search_batch`, but dispatched in bounded segments.
+
+    order_jitter/group (B,): Lazy-SMP lane-group metadata — see
+    init_state. required (B,) bool: the lanes whose completion the
+    caller actually needs (the PRIMARY lanes of helper groups). Once
+    every required lane is DONE the host stops dispatching segments and
+    abandons the rest mid-flight — helper lanes exist only to feed the
+    shared TT, and a lockstep step costs the same however few lanes run,
+    so finishing them would pay pure wall-clock for entries nobody will
+    read. None means every lane is required (the pre-helper behavior).
+    prefer_deep_store + tt_gen: store policy for helper dispatches
+    (ops/tt.py store).
 
     window: optional (root_alpha (B,), root_beta (B,)) aspiration window;
     a root whose true value falls outside reports a bound (fail-low /
@@ -1004,6 +1068,7 @@ def search_batch_resumable(
         params, roots, depth, node_budget, max_ply, variant,
         hist_hash=hist_hash, hist_halfmove=hist_halfmove,
         root_alpha=root_alpha, root_beta=root_beta,
+        order_jitter=order_jitter, group=group,
     )
     if mesh is not None:
         from ..parallel.mesh import run_segment_sharded
@@ -1011,7 +1076,8 @@ def search_batch_resumable(
         def dispatch(state, tt):
             state, tt, n = run_segment_sharded(
                 mesh, params, state, tt, segment_steps, variant=variant,
-                deep_tt=deep_tt,
+                deep_tt=deep_tt, prefer_deep=prefer_deep_store,
+                tt_gen=tt_gen,
             )
             # devices stop independently; continue while ANY used the
             # full segment (i.e. may still have live lanes)
@@ -1019,7 +1085,8 @@ def search_batch_resumable(
     else:
         def dispatch(state, tt):
             state, tt, n = _run_segment_jit(
-                params, state, tt, segment_steps, variant, deep_tt
+                params, state, tt, segment_steps, variant, deep_tt,
+                prefer_deep_store, jnp.int32(tt_gen),
             )
             return state, tt, int(n)
 
@@ -1029,6 +1096,7 @@ def search_batch_resumable(
     flushed: dict[str, np.ndarray] | None = None
     orig = np.arange(B)
     valid = np.ones(B, bool)
+    req = None if required is None else np.asarray(required, bool).copy()
 
     def _flush(res: dict, mask: np.ndarray) -> None:
         nonlocal flushed
@@ -1049,6 +1117,10 @@ def search_batch_resumable(
         total += n  # sync point: segment finished on device
         if n < segment_steps:
             break  # every lane parked in DONE
+        if req is not None:
+            done_now = np.asarray(state.lane[:, LN_MODE] == MODE_DONE)
+            if not np.any(req & valid & ~done_now):
+                break  # all required lanes finished; abandon the helpers
         if deadline is not None and _time.monotonic() >= deadline:
             break
         cur = state.lane.shape[0]
@@ -1074,6 +1146,8 @@ def search_batch_resumable(
                 order = np.concatenate([keep, pad])
                 state = jax.tree.map(lambda a: a[jnp.asarray(order)], state)
                 orig = orig[order]
+                if req is not None:
+                    req = req[order]
                 valid = np.concatenate(
                     [np.ones(len(keep), bool), np.zeros(len(pad), bool)]
                 )

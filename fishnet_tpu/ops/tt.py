@@ -18,9 +18,17 @@ Entry layout (one packed (4,) int32 row per slot — see TTable):
     [0] check: hash2 ^ meta ^ move    (validation word, uint32 bits)
     [1] meta:  (score+32768) << 10 | searched_depth << 2 | flag
     [2] move:  the node's best move encoding (-1 when none)
-    [3] pad
+    [3] generation (0 for plain always-replace stores; see `store`)
 Mate-range scores are never stored (ply-relative mate distances don't
 transpose; skipping them keeps the table sound without ply adjustment).
+
+Helper-lane stores (Lazy-SMP lane groups, engine/tpu.py) opt into a
+depth-preferred, generation-aware replacement policy: within the current
+generation a shallower store never evicts a deeper entry, so the flood
+of low-depth writes from K-1 helper lanes can't wash out the primary
+path's deep entries. The generation word is NOT covered by the XOR check
+(a torn generation only mis-prefers replacement, never corrupts
+validation) and probes ignore it entirely.
 """
 from __future__ import annotations
 
@@ -229,18 +237,39 @@ def probe(tt: TTable, h1, h2, depth_left, alpha, beta,
     return usable, score, jnp.where(usable, move, -1), jnp.where(valid, move, -1)
 
 
-def store(tt: TTable, h1, h2, score, depth, flag, move, mask):
+def store(tt: TTable, h1, h2, score, depth, flag, move, mask,
+          prefer_deep: bool = False, gen=None):
     """Batched store; lanes with mask=False write nothing. Always-replace
-    scheme (simple and effective for short batched searches)."""
+    scheme (simple and effective for short batched searches).
+
+    prefer_deep (STATIC) switches to depth-preferred, generation-aware
+    replacement for helper-lane dispatches: a slot holding a same-
+    generation entry of strictly greater depth is kept. Entries from any
+    other generation (including gen-0 plain stores and empty slots) are
+    always replaceable, so the policy self-heals across chunks without a
+    sweep. The extra row gather costs one more big-table access per store
+    site, which is why the plain path doesn't pay it. A torn old row can
+    misreport its depth and squat for the rest of the generation — rare
+    (needs a same-slot collision) and bounded to one chunk."""
     storable = mask & (jnp.abs(score) <= _MAX_STORE)
     slot = (h1 & jnp.uint32(tt.size - 1)).astype(jnp.int32)
+    gen_i = jnp.int32(0) if gen is None else jnp.asarray(gen, jnp.int32)
+    if prefer_deep:
+        old = tt.data[slot]  # (..., 4) row gather (pre-write snapshot)
+        _, old_depth, _ = unpack_meta(old[..., 1])
+        keep_old = (
+            (old[..., 1] != 0)
+            & (old[..., 3] == gen_i)
+            & (old_depth > depth)
+        )
+        storable = storable & ~keep_old
     slot = jnp.where(storable, slot, tt.size)  # out-of-range → dropped
     meta = pack_meta(score, depth, flag)
     check = h2 ^ meta.astype(jnp.uint32) ^ move.astype(jnp.uint32)
     rows = jnp.stack(
         [
             jax.lax.bitcast_convert_type(check, jnp.int32),
-            meta, move, jnp.zeros_like(meta),
+            meta, move, jnp.broadcast_to(gen_i, meta.shape),
         ],
         axis=-1,
     )

@@ -16,6 +16,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except AttributeError:  # older jax ships it under experimental, as check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     devices = jax.devices()
@@ -48,7 +56,8 @@ def replicate(mesh: Mesh, tree):
 
 @functools.lru_cache(maxsize=None)
 def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
-                      variant: str = "standard", deep_tt: bool = False):
+                      variant: str = "standard", deep_tt: bool = False,
+                      prefer_deep: bool = False):
     """shard_map'd search segment: each device advances ITS lanes with ITS
     transposition-table shard, fully locally — no collectives, and a device
     whose lanes all park in DONE exits its while_loop early instead of
@@ -57,38 +66,45 @@ def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
     (reference: src/main.rs:151-161)."""
     from ..ops.search import _run_segment
 
-    def seg(params, state, ttab):
+    def seg(params, state, ttab, tt_gen):
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[0], ttab)  # (1, N) block → (N,)
         state, ttab, n = _run_segment(
-            params, state, ttab, segment_steps, variant, deep_tt
+            params, state, ttab, segment_steps, variant, deep_tt,
+            prefer_deep, tt_gen,
         )
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[None], ttab)
         return state, ttab, n.reshape(1)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         seg,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis) if has_tt else P()),
+        in_specs=(P(), P(axis), P(axis) if has_tt else P(), P()),
         out_specs=(P(axis), P(axis) if has_tt else P(), P(axis)),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     return jax.jit(fn)
 
 
 def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
                         axis: str = "dp", variant: str = "standard",
-                        deep_tt: bool = False):
+                        deep_tt: bool = False, prefer_deep: bool = False,
+                        tt_gen: int = 0):
     """Advance a sharded search ≤ segment_steps on every device.
 
     state: SearchState with lane dim divisible by mesh size. ttab: TTable
     whose arrays carry a leading (n_devices,) shard dim (see
-    make_sharded_table), or None. Returns (state, ttab, steps (ndev,))."""
+    make_sharded_table), or None. Returns (state, ttab, steps (ndev,)).
+    prefer_deep/tt_gen: helper-lane TT store policy (ops/tt.py store);
+    the generation scalar is replicated across shards."""
+    import jax.numpy as jnp
+
     fn = _segment_callable(
-        mesh, axis, segment_steps, ttab is not None, variant, deep_tt
+        mesh, axis, segment_steps, ttab is not None, variant, deep_tt,
+        prefer_deep,
     )
-    return fn(params, state, ttab)
+    return fn(params, state, ttab, jnp.int32(tt_gen))
 
 
 def make_sharded_table(mesh: Mesh, size_log2: int):

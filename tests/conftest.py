@@ -22,6 +22,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # program takes minutes to compile on XLA:CPU; engine tests search depth ≤3
 os.environ.setdefault("FISHNET_TPU_MAX_PLY", "8")
 os.environ.setdefault("FISHNET_TPU_WARMUP_BUCKETS", "16")
+# Lazy-SMP helpers off by default under pytest: the production default
+# (K=4) widens every engine dispatch ~4x, which XLA:CPU pays in both
+# compile and step time across dozens of engine tests. Helper-lane
+# behavior is covered explicitly in tests/test_helper_lanes.py, which
+# constructs TpuEngine(helper_lanes=...) itself.
+os.environ.setdefault("FISHNET_TPU_HELPERS", "1")
 
 # persistent XLA compile cache for the whole suite (VERDICT r4 weak #7:
 # the fast tier outgrew its box — XLA:CPU compiles of unchanged search

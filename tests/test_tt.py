@@ -89,6 +89,66 @@ def test_store_probe_roundtrip():
     assert not bool(usable3[0]) and int(om3[0]) == -1
 
 
+def _store1(t, depth, score=100, move=42, gen=None, prefer_deep=False,
+            h1=5, h2=9):
+    """Single-slot store helper for the replacement-policy tests."""
+    return tt.store(
+        t, jnp.asarray([h1], jnp.uint32), jnp.asarray([h2], jnp.uint32),
+        score=jnp.asarray([score], jnp.int32),
+        depth=jnp.asarray([depth], jnp.int32),
+        flag=jnp.asarray([tt.FLAG_EXACT], jnp.int32),
+        move=jnp.asarray([move], jnp.int32),
+        mask=jnp.asarray([True]),
+        prefer_deep=prefer_deep, gen=gen,
+    )
+
+
+def _row(t, h1=5):
+    return np.asarray(t.data[h1 & (t.size - 1)])
+
+
+def test_prefer_deep_keeps_same_generation_deeper_entry():
+    """Helper-lane store policy: within one generation a shallower store
+    must not evict a deeper entry (the Lazy-SMP helpers' flood of
+    low-depth writes would otherwise wash out the primary's deep path)."""
+    t = _store1(tt.make_table(8), depth=5, move=111, gen=3, prefer_deep=True)
+    deep = _row(t)
+    # shallower same-generation store: dropped
+    t2 = _store1(t, depth=2, score=-7, move=222, gen=3, prefer_deep=True)
+    np.testing.assert_array_equal(_row(t2), deep)
+    # equal-depth same-generation store: replaces (only STRICTLY deeper
+    # entries are protected — newer information at the same depth wins)
+    t3 = _store1(t, depth=5, score=-40, move=333, gen=3, prefer_deep=True)
+    assert int(_row(t3)[2]) == 333
+
+
+def test_prefer_deep_other_generation_always_replaceable():
+    """Entries from any other generation — older chunks' helper stores
+    and gen-0 plain stores alike — lose their depth protection, so the
+    policy self-heals across chunks without a sweep."""
+    t = _store1(tt.make_table(8), depth=7, move=111, gen=3, prefer_deep=True)
+    # next chunk's generation: a depth-1 store evicts the old depth-7
+    t2 = _store1(t, depth=1, move=222, gen=4, prefer_deep=True)
+    assert int(_row(t2)[2]) == 222 and int(_row(t2)[3]) == 4
+    # plain always-replace store (gen word 0) ignores the policy entirely
+    t3 = _store1(t, depth=0, move=333)
+    assert int(_row(t3)[2]) == 333 and int(_row(t3)[3]) == 0
+    # and a later prefer_deep store replaces the gen-0 row at any depth
+    t4 = _store1(t3, depth=1, move=444, gen=5, prefer_deep=True)
+    assert int(_row(t4)[2]) == 444
+
+
+def test_prefer_deep_gen_none_matches_plain_store():
+    """store(..., gen=None) writes bit-identical rows to the pre-helper
+    plain store — the K=1 engine path must stay byte-for-byte the same."""
+    plain = _store1(tt.make_table(8), depth=3)
+    helper_off = _store1(tt.make_table(8), depth=3, prefer_deep=False,
+                         gen=None)
+    np.testing.assert_array_equal(
+        np.asarray(plain.data), np.asarray(helper_off.data)
+    )
+
+
 def test_store_mask_and_mate_filter():
     t = tt.make_table(8)
     t2 = tt.store(

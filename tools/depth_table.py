@@ -11,10 +11,13 @@ Usage:
   python tools/depth_table.py --depths 4,6,8 --lanes 256
   FISHNET_TPU_NO_PRUNING=1 python tools/depth_table.py ...   # A/B pruning
   python tools/depth_table.py --force-cpu ...                # node counts only
+  python tools/depth_table.py --helpers 4 ...                # Lazy-SMP lanes
 
-Prints one JSON line per depth:
-  {"depth": D, "lanes": B, "nodes": total, "wall_s": t, "nps": n,
-   "platform": ..., "pruning": ..., "done": all_lanes_finished}
+--helpers K > 1 replicates each root across K-1 extra lanes with perturbed
+move ordering (ops/search.py order_jitter), sharing the TT with
+depth-preferred stores — the engine's helper-lane configuration. The JSON
+then counts primary lanes/nodes separately and reports lockstep steps,
+the platform-independent cost proxy (equal widths ⇒ wall ∝ steps).
 """
 from __future__ import annotations
 
@@ -35,6 +38,8 @@ def main() -> int:
     ap.add_argument("--max-ply", type=int, default=None,
                     help="default: engine MAX_PLY (32 in production)")
     ap.add_argument("--tt-log2", type=int, default=21)
+    ap.add_argument("--helpers", type=int, default=1,
+                    help="Lazy-SMP lanes per position (1 disables)")
     ap.add_argument("--force-cpu", action="store_true")
     args = ap.parse_args()
 
@@ -66,11 +71,29 @@ def main() -> int:
         "4k3/8/8/8/8/8/4P3/4K3 w - - 0 1",
         "6k1/5ppp/8/8/8/8/5PPP/3R2K1 w - - 0 1",
     ]
+    import jax.numpy as jnp
+
     B = args.lanes
-    roots = stack_boards(
-        [from_position(Position.from_fen(fens[i % len(fens)]))
-         for i in range(B)]
-    )
+    K = max(1, args.helpers)
+    Bt = B * K
+    boards = [from_position(Position.from_fen(fens[i % len(fens)]))
+              for i in range(B)]
+    # helper layout mirrors bench.py: primaries in rows [0, B), then K-1
+    # replica blocks; row h*B + j helps primary j via the shared TT
+    roots = stack_boards(boards * K)
+    helper_kw = {}
+    if K > 1:
+        jit_arr = np.zeros(Bt, np.int32)
+        for h in range(1, K):
+            for j in range(B):
+                jit_arr[h * B + j] = j * K + h  # nonzero ⇔ helper lane
+        required = np.zeros(Bt, bool)
+        required[:B] = True  # a depth is "done" when the primaries are
+        helper_kw = dict(
+            order_jitter=jnp.asarray(jit_arr),
+            group=jnp.asarray(np.arange(Bt, dtype=np.int32) % B),
+            required=required, prefer_deep_store=True, tt_gen=1,
+        )
     from fishnet_tpu.assets import load_default_params
 
     params = load_default_params("board768") or nnue.init_params(
@@ -81,9 +104,9 @@ def main() -> int:
     for d in (int(x) for x in args.depths.split(",") if x):
         # fresh TT per depth so depths don't subsidize each other
         tt_d = tt_mod.make_table(args.tt_log2) if args.tt_log2 else None
-        # warmup dispatch compiles the (B, max_ply) program
+        # warmup dispatch compiles the (Bt, max_ply) program
         out = search_batch_resumable(
-            params, roots, 1, 64, max_ply=max_ply, tt=tt_d,
+            params, roots, 1, 64, max_ply=max_ply, tt=tt_d, **helper_kw,
         )
         out.pop("tt")
         jax.block_until_ready(out["nodes"])
@@ -91,18 +114,21 @@ def main() -> int:
         t0 = time.perf_counter()
         out = search_batch_resumable(
             params, roots, d, args.budget, max_ply=max_ply, tt=tt_d,
-            max_steps=50_000_000,
+            max_steps=50_000_000, **helper_kw,
         )
         out.pop("tt")
         jax.block_until_ready(out["nodes"])
         wall = time.perf_counter() - t0
         nodes = int(np.asarray(out["nodes"]).sum())
+        primary_nodes = int(np.asarray(out["nodes"])[:B].sum())
         print(json.dumps({
-            "depth": d, "lanes": B, "nodes": nodes,
+            "depth": d, "lanes": B, "helpers": K, "nodes": nodes,
+            "primary_nodes": primary_nodes,
+            "steps": int(out["steps"]),
             "wall_s": round(wall, 3), "nps": round(nodes / wall),
-            "per_pos_nodes": nodes // B,
+            "per_pos_nodes": primary_nodes // B,
             "platform": platform, "pruning": _PRUNING,
-            "done": bool(np.asarray(out["done"]).all()),
+            "done": bool(np.asarray(out["done"])[:B].all()),
         }), flush=True)
     return 0
 

@@ -51,6 +51,11 @@ def main() -> int:
                     help="run on the real accelerator (default: force "
                          "CPU, the tool's historical mode — device runs "
                          "are ~50x faster per cycle)")
+    ap.add_argument("--helpers", type=int, default=1,
+                    help="Lazy-SMP helper lanes per game position for the "
+                         "full-strength move dispatches (1 disables; "
+                         "skill-sampled dispatches already decompose root "
+                         "moves into lanes and ignore this)")
     args = ap.parse_args()
 
     if not args.device:
@@ -91,10 +96,30 @@ def main() -> int:
     from fishnet_tpu.ops import tt as tt_mod
 
     B0 = ((args.games + PAD - 1) // PAD) * PAD
+    # Lazy-SMP helper lanes (engine/tpu.py layout): primaries in rows
+    # [0, B0), then K-1 replica blocks — row h*B0 + r re-searches row r
+    # with perturbed ordering through the side's shared TT. Still one
+    # compiled shape per match; the picks come from primary rows only.
+    K = max(1, args.helpers)
+    helper_kw = {}
+    if K > 1:
+        import jax.numpy as jnp
+
+        jit_arr = np.zeros(B0 * K, np.int32)
+        for h in range(1, K):
+            for r in range(B0):
+                jit_arr[h * B0 + r] = r * K + h  # nonzero ⇔ helper lane
+        helper_kw = dict(
+            order_jitter=jnp.asarray(jit_arr),
+            group=jnp.asarray(np.arange(B0 * K, dtype=np.int32) % B0),
+            prefer_deep_store=True,
+        )
     # one persistent TT per side, carried across move cycles (the engine
     # keeps one per process too): without it every move re-searches its
     # whole tree and a 160-game match costs ~an hour of device time
     side_tt = {}
+    side_gen = {}  # per-side TT generation, bumped per dispatch (engine
+    # parity: old-generation entries lose depth-preferred protection)
 
     def device_moves(positions, p=None, depth=None, side="net"):
         """One batched dispatch: best move per position (None on fail)."""
@@ -103,12 +128,20 @@ def main() -> int:
         p = params if p is None else p
         depth = args.depth if depth is None else depth
         boards = [from_position(pos) for pos in positions]
-        roots = stack_boards(boards + [boards[0]] * (B0 - len(boards)))
+        block = boards + [boards[0]] * (B0 - len(boards))
+        roots = stack_boards(block * K)
         if side not in side_tt:
             side_tt[side] = tt_mod.make_table(21)
+        kw = dict(helper_kw)
+        if K > 1:
+            side_gen[side] = (side_gen.get(side, 0) + 1) & 0x3FFFFFFF
+            kw["tt_gen"] = side_gen[side]
+            req = np.zeros(B0 * K, bool)
+            req[: len(boards)] = True  # stop when the real games resolve
+            kw["required"] = req
         out = search_batch_resumable(
             p, roots, depth, 500_000, max_ply=depth + 3, narrow=False,
-            tt=side_tt[side],
+            tt=side_tt[side], **kw,
         )
         side_tt[side] = out.pop("tt")
         ms = np.asarray(out["move"])[: len(boards)]
