@@ -115,9 +115,11 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     """Child process: run one (B, depth) stage with phase heartbeats.
 
     On success prints exactly one stdout line: RESULT {json}."""
+    from fishnet_tpu.utils import settings
+
     t0 = time.time()
-    mode = ("scatter" if os.environ.get("FISHNET_TPU_SELECT_UPDATES") == "0"
-            else "select")
+    mode = ("select" if settings.get_bool("FISHNET_TPU_SELECT_UPDATES")
+            else "scatter")
     _hb(t0, f"stage B={B} depth={depth} variant={variant} set={fen_set} "
             f"row_mode={mode}: importing jax")
     import jax
@@ -289,11 +291,7 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                 "platform": platform,
                 "variant": variant,
                 "fen_set": fen_set,
-                "row_mode": (
-                    "scatter"
-                    if os.environ.get("FISHNET_TPU_SELECT_UPDATES") == "0"
-                    else "select"
-                ),
+                "row_mode": mode,
                 "max_ply": max_ply,
                 # primaries only: with helpers the first B rows are the
                 # analysed positions; helper completions are not output

@@ -14,12 +14,13 @@ from pathlib import Path
 from typing import Optional, Set
 
 from ..engine.pyengine import PyEngine
-from .api import ApiClient, Endpoint
+from ..utils import settings
+from .api import ApiClient, ApiError, Endpoint
 from .configure import Config
 from .logger import Logger
 from .queue import BacklogOpt, Queue
 from .stats import StatsRecorder
-from .update import DEFAULT_BUCKET_URL, auto_update, restart_process
+from .update import auto_update, restart_process
 from .wire import EngineFlavor
 from .workers import worker
 
@@ -101,7 +102,7 @@ async def run(cfg: Config) -> int:
     logger = Logger(verbose=cfg.verbose)
     logger.headline(f"fishnet-tpu starting ({cfg.cores} cores, backend={cfg.backend})")
 
-    bucket_url = os.environ.get("FISHNET_TPU_UPDATE_URL", DEFAULT_BUCKET_URL)
+    bucket_url = settings.get_str("FISHNET_TPU_UPDATE_URL")
     if cfg.auto_update:
         # startup check (reference: src/main.rs:50-68): update THEN exec a
         # fresh process so work starts on the new version
@@ -269,7 +270,7 @@ def _sync_check_key(endpoint: str, key: str) -> bool:
     try:
         api = ApiClient(Endpoint(endpoint), key, logger=Logger(verbose=0))
         return asyncio.run(api.check_key())
-    except Exception:
+    except (ApiError, OSError):
         return True  # network trouble: accept and let `run` find out
 
 

@@ -18,12 +18,16 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import List, Optional
 
+from ..utils import settings
+
 _S3_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
 _VERSION_RE = re.compile(r"v?(\d+)\.(\d+)\.(\d+)")
 
 # release channel (reference: src/update.rs:24 fishnet-releases bucket);
-# FISHNET_TPU_UPDATE_URL overrides (e.g. a local fixture in tests)
-DEFAULT_BUCKET_URL = "https://fishnet-tpu-releases.s3.amazonaws.com/"
+# FISHNET_TPU_UPDATE_URL overrides (e.g. a local fixture in tests). The
+# canonical default lives in the settings registry — single source of
+# truth for every env-var default (utils/settings.py).
+DEFAULT_BUCKET_URL = settings.lookup("FISHNET_TPU_UPDATE_URL").default
 
 
 def current_target() -> str:

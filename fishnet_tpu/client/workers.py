@@ -72,25 +72,25 @@ async def worker(
                     f"Worker {index} chunk of batch {chunk.work.id} timed out;"
                     " dropping engine"
                 )
-                await _drop_engine(engines, flavor)
+                await _drop_engine(engines, flavor, logger)
                 responses = ChunkFailed(chunk.work.id)
             except EngineError as e:
                 logger.error(f"Worker {index} engine error: {e}; dropping engine")
-                await _drop_engine(engines, flavor)
+                await _drop_engine(engines, flavor, logger)
                 backoffs.setdefault(flavor, RandomizedBackoff()).next()
                 responses = ChunkFailed(chunk.work.id)
     finally:
         for engine in engines.values():
             try:
                 await engine.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug(f"Worker {index} engine close failed: {e}")
 
 
-async def _drop_engine(engines: Dict, flavor) -> None:
+async def _drop_engine(engines: Dict, flavor, logger: Logger) -> None:
     engine = engines.pop(flavor, None)
     if engine is not None:
         try:
             await engine.close()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug(f"Dropped {flavor.value} engine close failed: {e}")

@@ -151,8 +151,8 @@ def main(argv=None) -> int:
 
     try:
         asyncio.run(engine.close())
-    except Exception:
-        pass
+    except Exception as e:
+        log(f"engine close failed: {type(e).__name__}: {e}")
     return 0
 
 

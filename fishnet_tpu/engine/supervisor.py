@@ -43,6 +43,7 @@ from typing import Deque, List, Optional
 from ..client.backoff import RandomizedBackoff
 from ..client.ipc import Chunk, PositionResponse, chunk_to_wire, responses_from_wire
 from ..client.logger import Logger
+from ..utils import settings
 from .base import EngineError
 from .frames import FrameError, PipeClosed, encode, read_frame_async
 
@@ -330,6 +331,10 @@ class SupervisedEngine:
         env["PYTHONPATH"] = _PKG_PARENT + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
         )
+        # engine-affecting FISHNET_TPU_* vars explicitly, so a future
+        # sanitized-env spawn can't strand engine config on the parent
+        # side (lint rule config-engine-wire keeps this line honest)
+        env.update(settings.engine_env())
         if self.env:
             env.update({k: str(v) for k, v in self.env.items()})
         try:

@@ -12,7 +12,6 @@ program shapes, then caches.
 from __future__ import annotations
 
 import asyncio
-import os
 import sys
 import threading
 import time
@@ -29,6 +28,7 @@ from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
 from ..ops.board import from_position, stack_boards
 from ..ops.search import INF, MATE, search_batch_resumable
+from ..utils import settings
 from .base import EngineError
 
 # static stack depth; supports search depths up to MAX_PLY-1, with the
@@ -36,7 +36,7 @@ from .base import EngineError
 # depth-22 move jobs 10 QS plies — reference skill-8 depth, src/api.rs:275-281).
 # Env-tunable because compile cost scales with it: tests and CPU smoke runs
 # set a small value (the full program takes minutes to compile on XLA:CPU)
-MAX_PLY = int(os.environ.get("FISHNET_TPU_MAX_PLY", "32"))
+MAX_PLY = settings.get_int("FISHNET_TPU_MAX_PLY")
 # 16 covers every single-pv chunk (planner emits ≤10 positions per chunk,
 # incl. skip-overlap re-appends — client/planner.py); 64 covers multipv
 # root-move lanes. Fewer buckets = fewer cold XLA compiles to warm up.
@@ -50,12 +50,7 @@ LANE_BUCKETS = (16, 64, 128, 256)
 # the windowed tree it cuts outweighs the re-searches, and the 120 rung
 # catches 90% of the escapees. The old hardcoded (30, 200) measured ~5%
 # more nodes; wider schedules up to (60, 250) measured ~9-14% more.
-_asp_env = os.environ.get("FISHNET_TPU_ASPIRATION")
-ASPIRATION_DELTAS = (
-    tuple(int(x) for x in _asp_env.split(",") if x)
-    if _asp_env
-    else (15, 120)
-)
+ASPIRATION_DELTAS = settings.get_csv_int("FISHNET_TPU_ASPIRATION") or (15, 120)
 
 
 def _decode_uci(m: int) -> str:
@@ -200,11 +195,11 @@ class TpuEngine:
         # the production shape (round 5, bench_matrix.json dtype_int8:
         # 37.2 knps vs 58-95 knps f32 — int32 dots keep the MXU idle),
         # so it survives only as an experiment behind an extra flag.
-        dtype_env = os.environ.get("FISHNET_TPU_DTYPE", "").lower()
+        dtype_env = (settings.get_str("FISHNET_TPU_DTYPE") or "").lower()
         if dtype_env in ("bf16", "bfloat16"):
             params = nnue.cast_params(params, jnp.bfloat16)
         elif dtype_env == "int8":
-            if os.environ.get("FISHNET_TPU_EXPERIMENTAL_INT8") == "1":
+            if settings.get_bool("FISHNET_TPU_EXPERIMENTAL_INT8"):
                 self._warn(
                     "experimental int8 weights enabled: measured SLOWER "
                     "than f32 at production shapes (37.2 vs 58-95 knps, "
@@ -227,7 +222,7 @@ class TpuEngine:
         self.max_lanes = (
             max_lanes
             if max_lanes is not None
-            else int(os.environ.get("FISHNET_TPU_MAX_LANES", "1024"))
+            else settings.get_int("FISHNET_TPU_MAX_LANES")
         )
         # Lazy-SMP helper lanes (docs/profile-r5.md §"Batch completion of
         # deep searches"): an analysed position may occupy up to K lanes —
@@ -239,7 +234,7 @@ class TpuEngine:
         # the pre-helper engine; no TT forces K=1 (helpers without the
         # communication channel are pure waste).
         if helper_lanes is None:
-            helper_lanes = int(os.environ.get("FISHNET_TPU_HELPERS", "4"))
+            helper_lanes = settings.get_int("FISHNET_TPU_HELPERS")
         self.helper_lanes = max(1, min(int(helper_lanes), 16))
         if self.tt is None:
             self.helper_lanes = 1
@@ -257,7 +252,7 @@ class TpuEngine:
         # of a shape, steady-state cost as the later ones)
         self.trace = (
             (lambda msg: print(f"T: {msg}", file=sys.stderr, flush=True))
-            if os.environ.get("FISHNET_TPU_TRACE")
+            if settings.get_bool("FISHNET_TPU_TRACE")
             else None
         )
 
@@ -291,13 +286,11 @@ class TpuEngine:
         # only the no-argument production default pays for full prep
         trimmed = buckets is not None
         if buckets is None:
-            env = os.environ.get("FISHNET_TPU_WARMUP_BUCKETS")
             buckets = (
-                tuple(int(x) for x in env.split(",") if x)
-                if env
-                else LANE_BUCKETS
+                settings.get_csv_int("FISHNET_TPU_WARMUP_BUCKETS")
+                or LANE_BUCKETS
             )
-            trimmed = env is not None
+            trimmed = settings.is_set("FISHNET_TPU_WARMUP_BUCKETS")
         for b in buckets:
             b = self._pad(b)
             t0 = _time.monotonic()
@@ -355,7 +348,7 @@ class TpuEngine:
         runs)."""
         import time as _time
 
-        env = os.environ.get("FISHNET_TPU_WARMUP_VARIANTS", "auto")
+        env = settings.get_str("FISHNET_TPU_WARMUP_VARIANTS") or "auto"
         if env.lower() == "auto":
             if jax.default_backend() == "cpu":
                 return

@@ -187,6 +187,10 @@ class UciEngine:
         try:
             if proc.returncode is None:
                 proc.kill()
-            await proc.wait()
+            # bounded: a kill that doesn't stick (stuck in uninterruptible
+            # IO) must not wedge close() forever
+            await asyncio.wait_for(proc.wait(), timeout=10.0)
         except ProcessLookupError:
             pass
+        except asyncio.TimeoutError:
+            pass  # killed but unreaped; abandon rather than block

@@ -1,0 +1,17 @@
+"""fishnet-lint: project-invariant static analysis.
+
+Pure-stdlib AST checks for the invariants this codebase depends on but
+Python never enforces: trace-safety in the jit kernels, the
+FISHNET_TPU_* settings registry contract, dataclass↔serde schema
+agreement, and the no-unbounded-blocking discipline of the supervisor
+stack. Run as `python -m fishnet_tpu.lint`; see docs/lint.md.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    dump_baseline,
+    families,
+    load_baseline,
+    run_lint,
+)

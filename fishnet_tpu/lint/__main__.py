@@ -1,0 +1,124 @@
+"""CLI for fishnet-lint.
+
+    python -m fishnet_tpu.lint                    # lint the repo
+    python -m fishnet_tpu.lint --format=github    # CI annotations
+    python -m fishnet_tpu.lint --write-baseline   # absolve current findings
+    python -m fishnet_tpu.lint --list-rules
+
+Exit codes: 0 clean (or everything baselined), 1 active findings or a
+stale baseline, 2 internal error (unparseable file, bad baseline).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import Project, dump_baseline, families, load_baseline, run_lint
+
+DEFAULT_BASELINE = "lint-baseline.json"
+
+
+def _detect_root() -> Path:
+    import fishnet_tpu
+
+    return Path(fishnet_tpu.__file__).resolve().parents[1]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fishnet_tpu.lint",
+        description="Project-invariant static analysis for fishnet-tpu.",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=None,
+        help="project root to scan (default: the repo this package is in)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "github", "json"), default="text",
+        help="output format (github emits workflow error annotations)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE} when it "
+             "exists)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current active findings to the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--family", action="append", dest="only_families", metavar="NAME",
+        help="run only this rule family (repeatable); see --list-rules",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="list rule families and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        # importing run_lint's rule modules registers the families
+        from . import concurrency_rules  # noqa: F401
+        from . import config_rules  # noqa: F401
+        from . import trace_rules  # noqa: F401
+        from . import wire_rules  # noqa: F401
+
+        for name, fn in families():
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{name}: {doc[0] if doc else ''}".strip())
+        return 0
+
+    root = (args.root or _detect_root()).resolve()
+    baseline_path = args.baseline or (root / DEFAULT_BASELINE)
+
+    try:
+        project = Project.load(root)
+    except SyntaxError as e:
+        print(f"fishnet-lint: {e}", file=sys.stderr)
+        return 2
+
+    baseline: List[str] = []
+    if not args.write_baseline and baseline_path.is_file():
+        try:
+            baseline = load_baseline(baseline_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"fishnet-lint: {e}", file=sys.stderr)
+            return 2
+
+    only = set(args.only_families) if args.only_families else None
+    result = run_lint(project, baseline=baseline, only_families=only)
+
+    if args.write_baseline:
+        baseline_path.write_text(dump_baseline(result.active),
+                                 encoding="utf-8")
+        print(f"fishnet-lint: wrote {len(result.active)} entries to "
+              f"{baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in result.findings],
+            "stale_baseline": result.stale_baseline,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format_github() if args.format == "github"
+                  else f.format_text())
+        for entry in result.stale_baseline:
+            print(f"stale baseline entry (finding fixed? run "
+                  f"--write-baseline): {entry}")
+        active = len(result.active)
+        baselined = len(result.findings) - active
+        tail = f", {baselined} baselined" if baselined else ""
+        stale = len(result.stale_baseline)
+        tail += f", {stale} stale baseline entries" if stale else ""
+        print(f"fishnet-lint: {active} active findings{tail}")
+
+    return 1 if (result.failed or result.stale_baseline) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""Concurrency rules for the supervisor/worker/queue machinery.
+
+The supervisor exists because a wedged device must never wedge the
+client (docs/tpu-hang.md); these rules keep the discipline that makes
+that true:
+
+  conc-no-timeout      .join()/.get()/.wait()/.recv() with no timeout
+                       and no surrounding asyncio.wait_for — an
+                       unbounded block on a peer that may be wedged
+  conc-block-in-lock   a known-blocking call inside `with <lock>:` —
+                       one stalled peer stalls every lock waiter
+  conc-bare-except     `except:` catches SystemExit/KeyboardInterrupt
+  conc-swallow-base    `except BaseException:` without a re-raise
+  conc-silent-except   a broad handler (Exception/BaseException/bare)
+                       whose body neither logs nor raises — failures
+                       vanish without a trace
+
+Scopes: the timeout/lock rules run on the process-boundary modules
+(supervisor, host, uci, workers, queue); the except rules run on all of
+client/ and engine/ (kernels and utils keep their own idioms — e.g.
+compile_cache deliberately degrades to "no cache" on any error).
+Narrow handlers (`except OSError: pass` around best-effort logging) are
+deliberately not flagged — the rules target *broad* swallowing.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import (
+    Finding,
+    Project,
+    dotted,
+    register_family,
+)
+
+# modules where an unbounded block is a liveness bug
+BLOCK_SCOPE = (
+    "fishnet_tpu/engine/supervisor.py",
+    "fishnet_tpu/engine/host.py",
+    "fishnet_tpu/engine/uci.py",
+    "fishnet_tpu/client/workers.py",
+    "fishnet_tpu/client/queue.py",
+)
+
+# modules where a swallowed exception hides an operational failure
+EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine")
+
+# attribute calls that block the caller until a peer acts
+_WAITING_ATTRS = ("join", "get", "wait", "recv")
+
+# calls that block; write_frame is excluded deliberately — host.py's
+# `with wlock: write_frame(...)` is the intended frame-stream serializer
+_BLOCKING_IN_LOCK = ("join", "get", "wait", "recv", "sleep", "read_frame",
+                     "acquire")
+
+_BROAD = ("Exception", "BaseException")
+
+_LOG_ATTRS = ("debug", "info", "warn", "warning", "error", "exception",
+              "log", "headline", "progress")
+
+
+def _parents(tree: ast.AST) -> dict:
+    out = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _inside_wait_for(node: ast.AST, parents: dict) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) and \
+                dotted(cur.func).split(".")[-1] == "wait_for":
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def _handler_type_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return []
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return [dotted(e).split(".")[-1] for e in elts]
+
+
+def _body_raises(body: List[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(ast.Module(
+        body=body, type_ignores=[])))
+
+
+def _body_logs(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            target = dotted(node.func)
+            tail = target.split(".")[-1]
+            if tail in _LOG_ATTRS or target in ("print", "log"):
+                return True
+    return False
+
+
+def _body_trivial(body: List[ast.stmt]) -> bool:
+    """pass/continue/break/`return <constant>`/docstring only — the
+    handler observably does nothing with the failure."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None or isinstance(stmt.value, ast.Constant)
+        ):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@register_family("concurrency")
+def check_concurrency(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    for src in project.in_dirs(*BLOCK_SCOPE):
+        parents = _parents(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+
+            if attr in _WAITING_ATTRS and not node.args and \
+                    not any(kw.arg == "timeout" for kw in node.keywords) and \
+                    not _inside_wait_for(node, parents):
+                findings.append(src.finding(
+                    "conc-no-timeout", node,
+                    f".{attr}() with no timeout blocks forever if the "
+                    "peer is wedged; pass timeout= or wrap in "
+                    "asyncio.wait_for",
+                ))
+
+        # blocking calls under a held (sync) lock; async locks are
+        # legitimately held across awaits, so only ast.With is scanned
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.With):
+                continue
+            held_lock = any(
+                "lock" in dotted(item.context_expr.func
+                                 if isinstance(item.context_expr, ast.Call)
+                                 else item.context_expr).lower()
+                for item in node.items
+            )
+            if not held_lock:
+                continue
+            for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+                if isinstance(sub, ast.Call):
+                    tail = dotted(sub.func).split(".")[-1]
+                    if tail in _BLOCKING_IN_LOCK:
+                        findings.append(src.finding(
+                            "conc-block-in-lock", sub,
+                            f"{tail}() while holding a lock; every other "
+                            "waiter stalls behind a wedged peer — move "
+                            "the blocking call outside the critical "
+                            "section",
+                        ))
+
+    for src in project.in_dirs(*EXCEPT_SCOPE):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _handler_type_names(node)
+            if node.type is None:
+                findings.append(src.finding(
+                    "conc-bare-except", node,
+                    "bare except also catches KeyboardInterrupt and "
+                    "SystemExit; catch Exception (or narrower)",
+                ))
+            if "BaseException" in names and not _body_raises(node.body):
+                findings.append(src.finding(
+                    "conc-swallow-base", node,
+                    "except BaseException without re-raise swallows "
+                    "KeyboardInterrupt/SystemExit; re-raise or narrow",
+                ))
+            broad = node.type is None or any(n in _BROAD for n in names)
+            if broad and _body_trivial(node.body) and \
+                    not _body_logs(node.body):
+                findings.append(src.finding(
+                    "conc-silent-except", node,
+                    "broad exception handler that neither logs nor "
+                    "raises; failures vanish without a trace — log the "
+                    "exception or narrow the type",
+                ))
+
+    return findings

@@ -1,0 +1,270 @@
+"""Config-coherence rules: the FISHNET_TPU_* env-var registry contract.
+
+`fishnet_tpu/utils/settings.py` is the single source of truth for every
+FISHNET_TPU_* environment variable. These rules keep the rest of the
+codebase honest about it:
+
+  config-env-read          a FISHNET_TPU_* name read directly from
+                           os.environ / os.getenv outside settings.py —
+                           use the typed accessors instead
+  config-env-write         a FISHNET_TPU_* name written to os.environ
+                           outside tests/, tools/, bench.py (production
+                           code must not mutate its own config)
+  config-env-unregistered  a FISHNET_TPU_* name used anywhere (accessor
+                           arg, environ access, subscript key) that has
+                           no registry entry
+  config-registry-literal  the SETTINGS tuple contains a non-literal
+                           entry, so the registry can't be extracted
+                           statically
+  config-doc-stale         docs/config.md does not match the table
+                           rendered from the registry (regenerate with
+                           `python -m fishnet_tpu.utils.settings`)
+  config-engine-wire       engine/supervisor.py no longer applies
+                           settings.engine_env() on spawn, stranding
+                           engine-affecting vars on the parent side
+
+The registry is extracted by AST from the scanned project's settings.py
+(never imported), so fixture projects in the lint's own tests can carry
+their own mini-registry.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from .core import (
+    Finding,
+    Project,
+    SourceFile,
+    call_name,
+    dotted,
+    register_family,
+    str_const,
+)
+
+PREFIX = "FISHNET_TPU_"
+SETTINGS_REL = "fishnet_tpu/utils/settings.py"
+SUPERVISOR_REL = "fishnet_tpu/engine/supervisor.py"
+CONFIG_MD_REL = "docs/config.md"
+
+# locations where writing FISHNET_TPU_* into os.environ is legitimate
+# (test setup, one-off tools, the bench driver building child envs)
+_WRITE_OK_PREFIXES = ("tests/", "tools/")
+_WRITE_OK_FILES = ("bench.py", "__graft_entry__.py")
+
+# typed accessors on the registry; the distinctive ones are also matched
+# bare (imported names), the generic ones only as settings.<name>
+_ACCESSORS = ("raw", "get_bool", "get_int", "get_str", "get_csv_int",
+              "is_set", "lookup")
+_DISTINCTIVE = ("get_bool", "get_int", "get_str", "get_csv_int", "is_set")
+
+_NAME_RE = re.compile(r"^FISHNET_TPU_[A-Z0-9_]+$")
+
+
+def extract_registry(
+    src: SourceFile,
+) -> Tuple[Optional[List[tuple]], List[Finding]]:
+    """AST-extract (name, kind, default, doc, engine) rows from the
+    literal SETTINGS tuple. Returns (rows, findings); rows is None when
+    no SETTINGS assignment exists, and findings carry any non-literal
+    entries (which also abort extraction)."""
+    value = None
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "SETTINGS":
+            value = node.value
+        elif isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "SETTINGS"
+            for t in node.targets
+        ):
+            value = node.value
+    if value is None:
+        return None, []
+
+    bad = src.finding(
+        "config-registry-literal", value,
+        "SETTINGS must be a tuple of Setting(...) calls with literal "
+        "string/bool arguments — the lint extracts it without importing",
+    )
+    if not isinstance(value, ast.Tuple):
+        return None, [bad]
+
+    rows: List[tuple] = []
+    for elt in value.elts:
+        if not (isinstance(elt, ast.Call)
+                and call_name(elt).split(".")[-1] == "Setting"):
+            return None, [bad]
+        kw = {k.arg: k.value for k in elt.keywords if k.arg}
+        name = str_const(kw.get("name", ast.Pass()))
+        kind = str_const(kw.get("kind", ast.Pass()))
+        default = str_const(kw.get("default", ast.Pass()))
+        doc = str_const(kw.get("doc", ast.Pass()))
+        engine = False
+        if "engine" in kw:
+            e = kw["engine"]
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, bool)):
+                return None, [bad]
+            engine = e.value
+        if None in (name, kind, default, doc):
+            return None, [bad]
+        rows.append((name, kind, default, doc, engine))
+    return rows, []
+
+
+def _literal_env_names(node: ast.Call) -> List[Tuple[ast.AST, str]]:
+    out = []
+    for arg in node.args[:1]:
+        s = str_const(arg)
+        if s is not None and s.startswith(PREFIX):
+            out.append((arg, s))
+    return out
+
+
+@register_family("config")
+def check_config_coherence(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    settings_src = project.file(SETTINGS_REL)
+    registered: Optional[set] = None
+    rows: Optional[List[tuple]] = None
+    if settings_src is not None:
+        rows, reg_findings = extract_registry(settings_src)
+        findings.extend(reg_findings)
+        if rows is not None:
+            registered = {r[0] for r in rows}
+
+    def check_registered(src: SourceFile, node: ast.AST, name: str) -> None:
+        if registered is not None and _NAME_RE.match(name) and \
+                name not in registered:
+            findings.append(src.finding(
+                "config-env-unregistered", node,
+                f"{name} is not registered in {SETTINGS_REL}; add a "
+                "Setting entry (and regenerate docs/config.md)",
+            ))
+
+    for src in project.files:
+        in_settings = src.rel == SETTINGS_REL
+        write_ok = (
+            src.rel.startswith(_WRITE_OK_PREFIXES)
+            or src.rel in _WRITE_OK_FILES
+        )
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                target = call_name(node)
+                tail = target.split(".")[-1]
+
+                is_environ_call = (
+                    target.endswith("os.environ.get")
+                    or target == "environ.get"
+                    or target.endswith("os.getenv")
+                    or target == "getenv"
+                )
+                is_environ_write_call = (
+                    target.endswith("environ.setdefault")
+                    or target.endswith("environ.pop")
+                )
+                is_accessor = (
+                    (target.startswith("settings.") and tail in _ACCESSORS)
+                    or (target in _DISTINCTIVE)
+                )
+
+                if is_environ_call or is_environ_write_call or is_accessor:
+                    for arg, name in _literal_env_names(node):
+                        check_registered(src, arg, name)
+                        if in_settings:
+                            continue
+                        if is_environ_call:
+                            findings.append(src.finding(
+                                "config-env-read", node,
+                                f"direct environment read of {name}; go "
+                                "through fishnet_tpu.utils.settings "
+                                "(typed accessors, normalized bool "
+                                "grammar, documented defaults)",
+                            ))
+                        elif is_environ_write_call and not write_ok:
+                            findings.append(src.finding(
+                                "config-env-write", node,
+                                f"production code mutates {name} in "
+                                "os.environ; config writes belong in "
+                                "tests/, tools/, or bench.py",
+                            ))
+
+            elif isinstance(node, ast.Subscript):
+                name = str_const(node.slice)
+                if name is None or not name.startswith(PREFIX):
+                    continue
+                base = dotted(node.value)
+                check_registered(src, node.slice, name)
+                if base.endswith("os.environ") or base == "environ":
+                    if in_settings:
+                        continue
+                    if isinstance(node.ctx, ast.Load):
+                        findings.append(src.finding(
+                            "config-env-read", node,
+                            f"direct environment read of {name}; go "
+                            "through fishnet_tpu.utils.settings",
+                        ))
+                    elif not write_ok:
+                        findings.append(src.finding(
+                            "config-env-write", node,
+                            f"production code mutates {name} in "
+                            "os.environ; config writes belong in "
+                            "tests/, tools/, or bench.py",
+                        ))
+
+            elif isinstance(node, ast.Compare):
+                # `"FISHNET_TPU_X" in os.environ` is a read in disguise
+                name = str_const(node.left)
+                if name and name.startswith(PREFIX) and len(node.ops) == 1 \
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                    comp = dotted(node.comparators[0])
+                    if comp.endswith("os.environ") or comp == "environ":
+                        check_registered(src, node.left, name)
+                        if not in_settings:
+                            findings.append(src.finding(
+                                "config-env-read", node,
+                                f"membership test of {name} in os.environ; "
+                                "use settings.is_set()",
+                            ))
+
+    # --- docs/config.md staleness -------------------------------------
+    if rows is not None:
+        from ..utils.settings import render_rows
+
+        anchor = settings_src.tree
+        doc_path = project.root / CONFIG_MD_REL
+        expected = render_rows(rows)
+        if not doc_path.is_file():
+            findings.append(settings_src.finding(
+                "config-doc-stale", anchor,
+                f"{CONFIG_MD_REL} is missing; generate it with "
+                "`python -m fishnet_tpu.utils.settings > docs/config.md`",
+            ))
+        elif doc_path.read_text(encoding="utf-8") != expected:
+            findings.append(settings_src.finding(
+                "config-doc-stale", anchor,
+                f"{CONFIG_MD_REL} does not match the registry; regenerate "
+                "with `python -m fishnet_tpu.utils.settings > "
+                "docs/config.md`",
+            ))
+
+    # --- engine-affecting vars must be wired to the engine host -------
+    supervisor = project.file(SUPERVISOR_REL)
+    if supervisor is not None and registered is not None:
+        wired = any(
+            isinstance(n, ast.Call)
+            and call_name(n).split(".")[-1] == "engine_env"
+            for n in ast.walk(supervisor.tree)
+        )
+        if not wired:
+            findings.append(supervisor.finding(
+                "config-engine-wire", supervisor.tree,
+                "the engine host spawn path no longer applies "
+                "settings.engine_env(); engine-affecting FISHNET_TPU_* "
+                "vars would strand on the parent side of a sanitized "
+                "spawn",
+            ))
+
+    return findings

@@ -1,0 +1,265 @@
+"""fishnet-lint core: findings, suppressions, baseline, project model.
+
+The suite is pure-stdlib AST analysis (no imports of the code under
+scan, no JAX) so it runs identically in a bare CI job, a pre-commit
+hook, and the test suite. Rules are project-invariant checks tailored
+to this codebase — see docs/lint.md for the rule catalogue.
+
+Suppression syntax (same line or a comment-only line directly above):
+
+    x = risky()  # fishnet-lint: disable=conc-no-timeout
+    # fishnet-lint: disable=trace-int-dtype,trace-py-branch
+    y = jnp.arange(8)
+
+Baseline: a checked-in JSON file of finding fingerprints (rule + file +
+stripped source line — line numbers deliberately excluded so unrelated
+edits don't invalidate it). Baselined findings are reported as such and
+do not fail the gate; `--write-baseline` regenerates the file.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+_SUPPRESS_RE = re.compile(r"#\s*fishnet-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # project-root-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    source_line: str = ""
+    baselined: bool = False
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.path}::{self.source_line.strip()}"
+
+    def format_text(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag}: {self.message}"
+
+    def format_github(self) -> str:
+        # GitHub annotation message field must be single-line
+        msg = self.message.replace("\n", " ")
+        return (
+            f"::error file={self.path},line={self.line},col={self.col},"
+            f"title=fishnet-lint {self.rule}::{msg}"
+        )
+
+
+class SourceFile:
+    """One parsed python file plus its suppression map."""
+
+    def __init__(self, root: Path, abspath: Path) -> None:
+        self.abspath = abspath
+        self.rel = abspath.relative_to(root).as_posix()
+        self.text = abspath.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=self.rel)
+        self.suppressions = self._parse_suppressions()
+
+    def _parse_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            target = i
+            if line.lstrip().startswith("#"):
+                target = i + 1  # comment-only line governs the next line
+            out.setdefault(target, set()).update(rules)
+        return out
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    def source_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0) + 1
+        return Finding(
+            rule=rule, path=self.rel, line=line, col=col,
+            message=message, source_line=self.source_at(line),
+        )
+
+
+# default scan set: the package, its drivers, and the test tree
+SCAN_GLOBS = (
+    "fishnet_tpu/**/*.py",
+    "tools/*.py",
+    "tests/*.py",
+    "bench.py",
+    "__graft_entry__.py",
+)
+
+
+class Project:
+    """The parsed file set of one repository (or test fixture) root."""
+
+    def __init__(self, root: Path, files: Sequence[SourceFile]) -> None:
+        self.root = Path(root)
+        self.files = list(files)
+        self._by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def load(cls, root: Path, globs: Iterable[str] = SCAN_GLOBS) -> "Project":
+        root = Path(root).resolve()
+        seen = set()
+        files: List[SourceFile] = []
+        errors: List[str] = []
+        for pattern in globs:
+            for p in sorted(root.glob(pattern)):
+                if not p.is_file() or p in seen or "__pycache__" in p.parts:
+                    continue
+                seen.add(p)
+                try:
+                    files.append(SourceFile(root, p))
+                except SyntaxError as e:
+                    errors.append(f"{p}: {e}")
+        if errors:
+            raise SyntaxError("unparseable files:\n" + "\n".join(errors))
+        return cls(root, files)
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def in_dirs(self, *prefixes: str) -> List[SourceFile]:
+        return [
+            f for f in self.files
+            if any(f.rel == p or f.rel.startswith(p.rstrip("/") + "/")
+                   for p in prefixes)
+        ]
+
+
+# ------------------------------------------------------------------ rules
+
+# a rule family is a callable Project -> List[Finding]; registration
+# keeps (family name, callable) so the CLI can filter/summarize
+_FAMILIES: List[tuple] = []
+
+
+def register_family(name: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        _FAMILIES.append((name, fn))
+        return fn
+
+    return deco
+
+
+def families() -> List[tuple]:
+    return list(_FAMILIES)
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.active)
+
+
+def run_lint(
+    project: Project,
+    baseline: Optional[Sequence[str]] = None,
+    only_families: Optional[Set[str]] = None,
+) -> LintResult:
+    # rule modules self-register on import
+    from . import concurrency_rules  # noqa: F401
+    from . import config_rules  # noqa: F401
+    from . import trace_rules  # noqa: F401
+    from . import wire_rules  # noqa: F401
+
+    findings: List[Finding] = []
+    for name, fn in families():
+        if only_families and name not in only_families:
+            continue
+        findings.extend(fn(project))
+
+    # drop inline-suppressed findings
+    kept: List[Finding] = []
+    for f in findings:
+        src = project.file(f.path)
+        if src is not None and src.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+
+    # consume baseline entries (multiset: one entry absolves one finding)
+    remaining: Dict[str, int] = {}
+    for entry in baseline or ():
+        remaining[entry] = remaining.get(entry, 0) + 1
+    for f in kept:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            f.baselined = True
+
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    stale = [e for e, n in remaining.items() if n > 0 for _ in range(n)]
+    return LintResult(findings=kept, stale_baseline=sorted(stale))
+
+
+# --------------------------------------------------------------- baseline
+
+
+def load_baseline(path: Path) -> List[str]:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or data.get("version") != 1:
+        raise ValueError(f"{path}: unsupported baseline format")
+    entries = data.get("entries", [])
+    if not all(isinstance(e, str) for e in entries):
+        raise ValueError(f"{path}: baseline entries must be strings")
+    return list(entries)
+
+
+def dump_baseline(findings: Iterable[Finding]) -> str:
+    entries = sorted(f.fingerprint() for f in findings)
+    return json.dumps({"version": 1, "entries": entries}, indent=2) + "\n"
+
+
+# ------------------------------------------------------------ AST helpers
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: 'os.environ.get', 'jnp.arange',
+    'foo'. Empty string for computed targets."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)

@@ -1,0 +1,321 @@
+"""Trace-safety rules for the jit-traced kernels.
+
+The kernels in ops/, models/, and engine/tpu.py rely on invariants that
+nothing enforces until trace time on hardware: no host synchronization
+inside traced code, no numpy applied to traced values, no Python control
+flow on traced expressions, and explicit dtypes on integer constructors
+(the uint64-bitboards-as-int32-bits discipline in ops/board.py breaks
+silently if a constructor picks a platform-dependent default).
+
+Scoping: a function is considered *traced* when it is (a) decorated with
+or wrapped by `jax.jit`, (b) passed to a `lax` control-flow combinator
+(while_loop/scan/cond/fori_loop/switch), (c) defined inside a traced
+function, (d) called (by simple name, intra-module) from a traced
+function, or (e) annotated with a `# fishnet-lint: traced` comment on
+the line above its `def`. Host-side drivers (iterative deepening,
+result extraction) in the same files are deliberately out of scope —
+`.item()` and `int()` are their job.
+
+Rules:
+  trace-host-item   .item()/.tolist() inside a traced function
+  trace-host-cast   int()/float()/bool() on a non-literal inside a
+                    traced function (host cast → trace error on device)
+  trace-np-mix      np.* applied to a jnp-derived expression inside a
+                    traced function
+  trace-py-branch   Python if/while/assert testing a jnp expression
+                    inside a traced function (use lax.cond/jnp.where)
+  trace-sync        .block_until_ready() in a trace-scoped file outside
+                    the allowlisted host-sync functions
+  trace-int-dtype   jnp.arange/zeros/ones/full/empty without an
+                    explicit dtype anywhere in a trace-scoped file
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import (
+    Finding,
+    Project,
+    SourceFile,
+    call_name,
+    dotted,
+    has_kwarg,
+    register_family,
+)
+
+TRACE_SCOPE = ("fishnet_tpu/ops", "fishnet_tpu/models", "fishnet_tpu/engine/tpu.py")
+
+# functions (by simple name) where a host sync is sanctioned even inside
+# trace-scoped files — extend deliberately, with a comment, or suppress
+# inline at the call site
+SYNC_ALLOWLIST: Set[str] = set()
+
+_TRACED_MARK_RE = re.compile(r"#\s*fishnet-lint:\s*traced\b")
+
+# dtype-less constructors whose default dtype is contextual; index of the
+# positional arg that would carry dtype
+_CTORS = {
+    "arange": 3,
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+}
+
+_LAX_HOFS = {
+    "while_loop": (0, 1),
+    "scan": (0,),
+    "cond": (1, 2),
+    "fori_loop": (2,),
+    "switch": None,  # every arg past the index may be a branch callable
+}
+
+
+class _FunctionInfo:
+    def __init__(self, node: ast.AST, parent: Optional["_FunctionInfo"]) -> None:
+        self.node = node
+        self.parent = parent
+        self.name = getattr(node, "name", "<lambda>")
+        self.calls: Set[str] = set()
+        self.traced = False
+
+
+def _index_functions(src: SourceFile):
+    """Map every function/lambda node to its info, recording parenthood
+    and intra-module simple-name call edges."""
+    infos: Dict[ast.AST, _FunctionInfo] = {}
+    by_name: Dict[str, List[_FunctionInfo]] = {}
+
+    def visit(node: ast.AST, parent: Optional[_FunctionInfo]) -> None:
+        info = parent
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            info = _FunctionInfo(node, parent)
+            infos[node] = info
+            if not isinstance(node, ast.Lambda):
+                by_name.setdefault(node.name, []).append(info)
+        if isinstance(node, ast.Call) and info is not None:
+            name = call_name(node)
+            if name:
+                info.calls.add(name.split(".")[-1])
+        for child in ast.iter_child_nodes(node):
+            visit(child, info)
+
+    visit(src.tree, None)
+    return infos, by_name
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jax.jit`, `jit`, or `partial(jax.jit, ...)`-style expressions."""
+    name = dotted(node)
+    if name in ("jit", "jax.jit", "nn.jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted(node.func)
+        if fn.split(".")[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _mark_roots(src: SourceFile, infos, by_name) -> None:
+    def mark_name(simple: str) -> None:
+        for info in by_name.get(simple, []):
+            info.traced = True
+
+    def mark_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            mark_name(arg.id)
+        elif isinstance(arg, ast.Lambda) and arg in infos:
+            infos[arg].traced = True
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_expr(deco):
+                    infos[node].traced = True
+            # explicit annotation: `# fishnet-lint: traced` above the def
+            deco_line = min(
+                [node.lineno] + [d.lineno for d in node.decorator_list]
+            )
+            above = src.source_at(deco_line - 1)
+            if _TRACED_MARK_RE.search(above):
+                infos[node].traced = True
+        elif isinstance(node, ast.Call):
+            target = call_name(node)
+            simple = target.split(".")[-1]
+            if _is_jit_expr(node.func):
+                for arg in node.args[:1]:
+                    mark_arg(arg)
+            elif simple in _LAX_HOFS and (
+                target.startswith("lax.") or target.startswith("jax.lax.")
+                or target == simple
+            ):
+                positions = _LAX_HOFS[simple]
+                if positions is None:
+                    for arg in node.args:
+                        mark_arg(arg)
+                else:
+                    for i in positions:
+                        if i < len(node.args):
+                            mark_arg(node.args[i])
+
+
+def _propagate(infos, by_name) -> None:
+    # nested-in-traced plus intra-module call edges, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for info in infos.values():
+            if not info.traced and info.parent is not None and info.parent.traced:
+                info.traced = True
+                changed = True
+            if info.traced:
+                for callee in info.calls:
+                    for target in by_name.get(callee, []):
+                        if not target.traced:
+                            target.traced = True
+                            changed = True
+
+
+def _contains_jnp(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Attribute, ast.Name)):
+            name = dotted(sub)
+            if name.startswith("jnp.") or name.startswith("jax.numpy."):
+                return True
+    return False
+
+
+def _jnp_tainted_names(fn_node: ast.AST) -> Set[str]:
+    """Names assigned (directly) from a jnp.* expression within fn."""
+    tainted: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and _contains_jnp(node.value):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    tainted.add(target.id)
+        elif isinstance(node, ast.AugAssign) and _contains_jnp(node.value):
+            if isinstance(node.target, ast.Name):
+                tainted.add(node.target.id)
+    return tainted
+
+
+@register_family("trace")
+def check_trace_safety(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project.in_dirs(*TRACE_SCOPE):
+        infos, by_name = _index_functions(src)
+        _mark_roots(src, infos, by_name)
+        _propagate(infos, by_name)
+
+        # map every AST node to its innermost enclosing function info
+        node_fn: Dict[ast.AST, _FunctionInfo] = {}
+
+        def assign(node, current):
+            if node in infos:
+                current = infos[node]
+            node_fn[node] = current
+            for child in ast.iter_child_nodes(node):
+                assign(child, current)
+
+        assign(src.tree, None)
+
+        taint_cache: Dict[ast.AST, Set[str]] = {}
+
+        for node in ast.walk(src.tree):
+            fn = node_fn.get(node)
+            traced = fn is not None and fn.traced
+
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                simple = name.split(".")[-1]
+
+                if traced and isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("item", "tolist") and not node.args:
+                    findings.append(src.finding(
+                        "trace-host-item", node,
+                        f".{node.func.attr}() forces a host sync and fails "
+                        "under trace; keep device values on device",
+                    ))
+
+                if traced and isinstance(node.func, ast.Name) and \
+                        node.func.id in ("int", "float", "bool") and \
+                        len(node.args) == 1 and \
+                        not isinstance(node.args[0], ast.Constant):
+                    findings.append(src.finding(
+                        "trace-host-cast", node,
+                        f"{node.func.id}() on a traced value is a host cast; "
+                        "use .astype()/jnp casts inside traced code",
+                    ))
+
+                if traced and name.startswith("np.") and node.args:
+                    root = fn.node
+                    if root not in taint_cache:
+                        taint_cache[root] = _jnp_tainted_names(root)
+                    tainted = taint_cache[root]
+                    for arg in node.args:
+                        if _contains_jnp(arg) or (
+                            isinstance(arg, ast.Name) and arg.id in tainted
+                        ):
+                            findings.append(src.finding(
+                                "trace-np-mix", node,
+                                f"{name}(...) applied to a jnp value inside "
+                                "traced code concretizes the tracer; use the "
+                                "jnp equivalent",
+                            ))
+                            break
+
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "block_until_ready":
+                    fname = fn.name if fn is not None else "<module>"
+                    if fname not in SYNC_ALLOWLIST:
+                        findings.append(src.finding(
+                            "trace-sync", node,
+                            "block_until_ready() outside the allowlist; host "
+                            "syncs belong in benchmarks and allowlisted "
+                            "drivers (lint/trace_rules.py SYNC_ALLOWLIST)",
+                        ))
+
+                if name.startswith("jnp.") and simple in _CTORS:
+                    dtype_pos = _CTORS[simple]
+                    if not has_kwarg(node, "dtype") and \
+                            len(node.args) <= dtype_pos:
+                        findings.append(src.finding(
+                            "trace-int-dtype", node,
+                            f"jnp.{simple}(...) without an explicit dtype; "
+                            "the int32-bits discipline requires explicit "
+                            "dtypes on constructors in kernel files",
+                        ))
+
+            elif isinstance(node, (ast.If, ast.While, ast.Assert)) and traced:
+                # `x is None` never inspects a traced value (tracers are
+                # never None) — the idiomatic optional-arg default branch
+                # in init paths is fine under trace
+                if isinstance(node.test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.test.ops
+                ):
+                    continue
+                root = fn.node
+                if root not in taint_cache:
+                    taint_cache[root] = _jnp_tainted_names(root)
+                tainted = taint_cache[root]
+                on_traced = _contains_jnp(node.test) or any(
+                    isinstance(sub, ast.Name) and sub.id in tainted
+                    for sub in ast.walk(node.test)
+                )
+                if on_traced and isinstance(node, ast.Assert):
+                    findings.append(src.finding(
+                        "trace-py-branch", node,
+                        "assert on a jnp expression inside traced code "
+                        "fails at trace time; use checkify or a host check",
+                    ))
+                elif on_traced:
+                    findings.append(src.finding(
+                        "trace-py-branch", node,
+                        "Python control flow on a jnp expression inside "
+                        "traced code; use lax.cond/lax.while_loop/jnp.where",
+                    ))
+    return findings

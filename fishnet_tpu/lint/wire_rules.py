@@ -1,0 +1,308 @@
+"""Wire-schema rules: dataclass fields vs serde keys.
+
+The supervisor↔host pipe protocol and the lichess wire model both use
+hand-written to/from JSON-dict converters over plain dataclasses
+(client/wire.py, client/ipc.py). Nothing ties a dataclass field to its
+serde key until a message round-trips at runtime — adding a field and
+forgetting one side silently drops data. These rules diff the two sides
+statically:
+
+  wire-field-missing        a dataclass field is never attribute-read in
+                            the pair's to-side functions (it won't be
+                            serialized)
+  wire-ctor-field-mismatch  a from-side constructor call passes a kwarg
+                            that is not a field, or omits a field with
+                            no default
+  wire-key-asymmetry        the literal key sets emitted by the to-side
+                            and consumed by the from-side differ
+
+Pairs are declared explicitly below (a pair may union helper functions:
+the work pair's from-side includes NodeLimit.from_json/Clock.from_json
+because the keys they consume are emitted by work_to_json's nested
+dicts). Dataclasses that carry a to_json/from_json method pair are also
+auto-discovered. A to-side that emits non-literal dict keys (Score's
+`{self.kind: self.value}`) opts its pair out of the key-asymmetry check
+only.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (
+    Finding,
+    Project,
+    SourceFile,
+    dotted,
+    register_family,
+    str_const,
+)
+
+
+@dataclass(frozen=True)
+class SerdePair:
+    name: str
+    file: str
+    to_fns: Tuple[str, ...]    # qualified: "work_to_json", "Score.to_json"
+    from_fns: Tuple[str, ...]
+    dataclasses: Tuple[str, ...]
+    # "Class.field" names that legitimately do not travel on this wire
+    # (e.g. PositionResponse.work rides in the surrounding frame)
+    exempt: Tuple[str, ...] = ()
+
+
+SERDE_PAIRS: Tuple[SerdePair, ...] = (
+    SerdePair(
+        name="work",
+        file="fishnet_tpu/client/wire.py",
+        to_fns=("work_to_json",),
+        from_fns=("work_from_json", "NodeLimit.from_json", "Clock.from_json"),
+        dataclasses=("AnalysisWork", "MoveWork", "NodeLimit", "Clock"),
+    ),
+    SerdePair(
+        name="chunk",
+        file="fishnet_tpu/client/ipc.py",
+        to_fns=("chunk_to_wire",),
+        from_fns=("chunk_from_wire",),
+        dataclasses=("Chunk", "WorkPosition"),
+        exempt=("WorkPosition.work",),  # rebuilt from the chunk's work
+    ),
+    SerdePair(
+        name="response",
+        file="fishnet_tpu/client/ipc.py",
+        to_fns=("response_to_wire",),
+        from_fns=("responses_from_wire",),
+        dataclasses=("PositionResponse",),
+        exempt=("PositionResponse.work",),  # travels in the frame header
+    ),
+    SerdePair(
+        name="score",
+        file="fishnet_tpu/client/wire.py",
+        to_fns=("Score.to_json",),
+        from_fns=("Score.from_json",),
+        dataclasses=("Score",),
+    ),
+)
+
+# files swept for auto-discovered to_json/from_json dataclass pairs
+AUTO_FILES = (
+    "fishnet_tpu/client/wire.py",
+    "fishnet_tpu/client/ipc.py",
+    "fishnet_tpu/engine/frames.py",
+)
+
+
+def _is_dataclass_def(node: ast.ClassDef) -> bool:
+    for deco in node.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if dotted(target).split(".")[-1] == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(node: ast.ClassDef) -> List[Tuple[str, bool]]:
+    """(field name, has_default) in declaration order; ClassVar and plain
+    assignments (constants) are not fields."""
+    out: List[Tuple[str, bool]] = []
+    for stmt in node.body:
+        if not (isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)):
+            continue
+        ann = stmt.annotation
+        ann_name = dotted(ann) if not isinstance(ann, ast.Subscript) \
+            else dotted(ann.value)
+        if ann_name.split(".")[-1] == "ClassVar":
+            continue
+        out.append((stmt.target.id, stmt.value is not None))
+    return out
+
+
+def _index_file(src: SourceFile):
+    """Qualified function map ('fn', 'Cls.fn') and dataclass defs."""
+    fns: Dict[str, ast.AST] = {}
+    classes: Dict[str, ast.ClassDef] = {}
+    for node in src.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes[node.name] = node
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fns[f"{node.name}.{stmt.name}"] = stmt
+    return fns, classes
+
+
+@dataclass
+class _SideKeys:
+    keys: Set[str] = field(default_factory=set)
+    dynamic: bool = False  # non-literal dict key seen on the to-side
+
+
+def _emitted_keys(fn_node: ast.AST) -> _SideKeys:
+    out = _SideKeys()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Dict):
+            for key in node.keys:
+                if key is None:  # **spread
+                    out.dynamic = True
+                    continue
+                s = str_const(key)
+                if s is None:
+                    out.dynamic = True
+                else:
+                    out.keys.add(s)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Store):
+            s = str_const(node.slice)
+            if s is not None:
+                out.keys.add(s)
+    return out
+
+
+def _consumed_keys(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            s = str_const(node.slice)
+            if s is not None:
+                out.add(s)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "get" and node.args:
+            s = str_const(node.args[0])
+            if s is not None:
+                out.add(s)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            s = str_const(node.left)
+            if s is not None:
+                out.add(s)
+    return out
+
+
+def _attr_reads(fn_nodes: List[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for fn_node in fn_nodes:
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Attribute):
+                out.add(node.attr)
+    return out
+
+
+def _discover_pairs(project: Project) -> List[SerdePair]:
+    pairs = list(SERDE_PAIRS)
+    covered = {(p.file, cls) for p in pairs for cls in p.dataclasses}
+    for rel in AUTO_FILES:
+        src = project.file(rel)
+        if src is None:
+            continue
+        _, classes = _index_file(src)
+        for cls_name, cls_node in classes.items():
+            if (rel, cls_name) in covered or not _is_dataclass_def(cls_node):
+                continue
+            methods = {
+                stmt.name for stmt in cls_node.body
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            if "to_json" in methods and "from_json" in methods:
+                pairs.append(SerdePair(
+                    name=cls_name.lower(),
+                    file=rel,
+                    to_fns=(f"{cls_name}.to_json",),
+                    from_fns=(f"{cls_name}.from_json",),
+                    dataclasses=(cls_name,),
+                ))
+    return pairs
+
+
+@register_family("wire")
+def check_wire_schema(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for pair in _discover_pairs(project):
+        src = project.file(pair.file)
+        if src is None:
+            continue
+        fns, classes = _index_file(src)
+
+        to_nodes = [fns[n] for n in pair.to_fns if n in fns]
+        from_nodes = [fns[n] for n in pair.from_fns if n in fns]
+        if not to_nodes or not from_nodes:
+            continue  # one-sided types (e.g. acquire body) are out of scope
+
+        # W001: every field must be attribute-read somewhere on the to-side
+        reads = _attr_reads(to_nodes)
+        for cls_name in pair.dataclasses:
+            cls_node = classes.get(cls_name)
+            if cls_node is None:
+                continue
+            for fname, _ in _dataclass_fields(cls_node):
+                if fname in reads or f"{cls_name}.{fname}" in pair.exempt:
+                    continue
+                findings.append(src.finding(
+                    "wire-field-missing", cls_node,
+                    f"{cls_name}.{fname} is never read by "
+                    f"{'/'.join(pair.to_fns)}; the field is silently "
+                    "dropped on serialization",
+                ))
+
+        # W002: from-side constructor calls vs the field list
+        for cls_name in pair.dataclasses:
+            cls_node = classes.get(cls_name)
+            if cls_node is None:
+                continue
+            fields = _dataclass_fields(cls_node)
+            fieldset = {f for f, _ in fields}
+            for fn_node in from_nodes:
+                for node in ast.walk(fn_node):
+                    if not (isinstance(node, ast.Call)
+                            and dotted(node.func) == cls_name):
+                        continue
+                    kwargs = {k.arg for k in node.keywords if k.arg}
+                    has_splat = any(k.arg is None for k in node.keywords)
+                    for kw in sorted(kwargs - fieldset):
+                        findings.append(src.finding(
+                            "wire-ctor-field-mismatch", node,
+                            f"{cls_name}(... {kw}=...) passes a kwarg "
+                            "that is not a dataclass field",
+                        ))
+                    if has_splat:
+                        continue
+                    positional = {f for f, _ in fields[:len(node.args)]}
+                    for fname, has_default in fields:
+                        if has_default or fname in kwargs \
+                                or fname in positional \
+                                or f"{cls_name}.{fname}" in pair.exempt:
+                            continue
+                        findings.append(src.finding(
+                            "wire-ctor-field-mismatch", node,
+                            f"{cls_name}(...) omits required field "
+                            f"{fname!r}",
+                        ))
+
+        # W003: literal key symmetry between the two sides
+        emitted = _SideKeys()
+        for fn_node in to_nodes:
+            side = _emitted_keys(fn_node)
+            emitted.keys |= side.keys
+            emitted.dynamic = emitted.dynamic or side.dynamic
+        if emitted.dynamic:
+            continue  # dynamic keys (Score) can't be diffed statically
+        consumed: Set[str] = set()
+        for fn_node in from_nodes:
+            consumed |= _consumed_keys(fn_node)
+        for key in sorted(emitted.keys - consumed):
+            findings.append(src.finding(
+                "wire-key-asymmetry", to_nodes[0],
+                f"serde pair {pair.name!r}: key {key!r} is emitted by "
+                f"{'/'.join(pair.to_fns)} but never consumed by "
+                f"{'/'.join(pair.from_fns)}",
+            ))
+        for key in sorted(consumed - emitted.keys):
+            findings.append(src.finding(
+                "wire-key-asymmetry", from_nodes[0],
+                f"serde pair {pair.name!r}: key {key!r} is consumed by "
+                f"{'/'.join(pair.from_fns)} but never emitted by "
+                f"{'/'.join(pair.to_fns)}",
+            ))
+    return findings

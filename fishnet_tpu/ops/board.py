@@ -212,7 +212,7 @@ def is_attacked(board64: jnp.ndarray, sq: jnp.ndarray, by_color: jnp.ndarray) ->
     before = exclusive_cumsum_small(occupied.astype(jnp.int32), axis=1)
     is_first = occupied & (before == 0)
     slider_ok = jnp.asarray(T.SLIDER_MASK)[
-        jnp.arange(8)[:, None], ray_pieces
+        jnp.arange(8, dtype=jnp.int32)[:, None], ray_pieces
     ]  # (8, 7) does this piece slide along this dir
     enemy = piece_color(ray_pieces) == by_color
     slider_hit = jnp.any(is_first & slider_ok & enemy & valid)
@@ -405,7 +405,7 @@ def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
 
     # castling rights: clear own on king move; clear a rook square on touch
     cast = b.castling
-    own_slots = jnp.arange(4) // 2 == us
+    own_slots = jnp.arange(4, dtype=jnp.int32) // 2 == us
     cast = jnp.where(is_king & own_slots, -1, cast)
     touched = (cast == frm) | (cast == to)
     if is_drop is not None:
@@ -450,7 +450,7 @@ def make_move(b: Board, move: jnp.ndarray, variant: str = "standard") -> Board:
         # representation, like from_position, ties rights to a live king)
         wk_alive = jnp.any(out_board == T.W_KING)
         bk_alive = jnp.any(out_board == T.B_KING)
-        slot_alive = jnp.where(jnp.arange(4) < 2, wk_alive, bk_alive)
+        slot_alive = jnp.where(jnp.arange(4, dtype=jnp.int32) < 2, wk_alive, bk_alive)
         cast = jnp.where(capture & ~slot_alive, -1, cast)
     pawnish = is_pawn
     if is_drop is not None:

@@ -333,7 +333,7 @@ def _candidate_space(b: Board, variant: str = "standard"):
     # --------------------------------------------------------------- castling
     ksq = king_square(board, us)
     ksq_c = jnp.maximum(ksq, 0)
-    rook_slots = jnp.take(b.castling, jnp.arange(2) + us * 2)  # [kingside, queenside]
+    rook_slots = jnp.take(b.castling, jnp.arange(2, dtype=jnp.int32) + us * 2)  # [kingside, queenside]
 
     def castle_ok(slot):
         rsq = rook_slots[slot]

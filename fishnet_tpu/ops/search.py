@@ -38,7 +38,6 @@ lanes are cheap, so multipv lanes are just more lanes.
 """
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
 
 import jax
@@ -46,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import nnue
+from ..utils import settings
 from .board import (
     TERM_LOSS,
     TERM_NONE,
@@ -124,7 +124,7 @@ _FM_ENTER[[NT_PVLEN, NT_INCHECK]] = True
 # serialized form costing 25 ms/step at B=256 vs select's 1.15 ms
 # (docs/profile-r5.md). The two modes are bit-identical
 # (tests/test_search.py proves it on CPU).
-_SELECT_UPDATES = os.environ.get("FISHNET_TPU_SELECT_UPDATES", "1") != "0"
+_SELECT_UPDATES = settings.get_bool("FISHNET_TPU_SELECT_UPDATES")
 
 # FISHNET_TPU_NO_PRUNING=1: disable null-move pruning, late-move
 # reductions AND futility pruning (debug/A-B lever; the oracle mirrors
@@ -141,7 +141,7 @@ _SELECT_UPDATES = os.environ.get("FISHNET_TPU_SELECT_UPDATES", "1") != "0"
 #   only re-search at full depth when the reduced result beats alpha.
 # ("" and "0" both leave pruning ON — same parse as SELECT_UPDATES, so
 # exporting the var as 0 never silently flips the search mode)
-_PRUNING = os.environ.get("FISHNET_TPU_NO_PRUNING", "") in ("", "0")
+_PRUNING = not settings.get_bool("FISHNET_TPU_NO_PRUNING")
 NULL_R = 2  # base null-move depth reduction (+1 at depth_left >= 7)
 
 

@@ -10,9 +10,10 @@ Disabled with FISHNET_TPU_NO_COMPILE_CACHE=1 (e.g. read-only filesystems).
 """
 from __future__ import annotations
 
-import os
 from pathlib import Path
 from typing import Optional
+
+from . import settings
 
 _enabled_path: Optional[Path] = None
 
@@ -23,7 +24,7 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
     Idempotent; returns the cache dir, or None when disabled/unavailable.
     Must be called before the first compilation to benefit it."""
     global _enabled_path
-    if os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
+    if settings.get_bool("FISHNET_TPU_NO_COMPILE_CACHE"):
         return None
     if _enabled_path is not None:
         return _enabled_path
@@ -32,7 +33,7 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
 
         p = Path(
             path
-            or os.environ.get("FISHNET_TPU_COMPILE_CACHE")
+            or settings.get_str("FISHNET_TPU_COMPILE_CACHE")
             or Path.home() / ".cache" / "fishnet-tpu" / "xla"
         )
         # namespace by backend: entries written through a remote-TPU

@@ -1,0 +1,306 @@
+"""Single source of truth for every FISHNET_TPU_* environment variable.
+
+The first rounds hand-threaded engine config through five layers and
+sprinkled 14 env vars across ~40 scattered `os.environ` read sites.
+This registry pins each variable once — name, type, default, doc line,
+and whether it is *engine-affecting* (changes search results or engine
+behavior, so it must reach the supervised engine host child process) —
+and every read in the codebase goes through the typed accessors below.
+
+The registry is enforced statically by `python -m fishnet_tpu.lint`
+(config-coherence rule family): a direct `os.environ` read of a
+FISHNET_TPU_* name anywhere else, an unregistered name, a stale
+docs/config.md table, or a supervisor spawn path that stops forwarding
+the engine-affecting vars all fail the gate. Keep this module pure
+stdlib — the linter and conftest import it before JAX exists.
+
+IMPORTANT for the linter: the SETTINGS tuple below must stay a literal
+(string/bool literals only, no computed values) — the lint extracts it
+by AST, without importing arbitrary project code.
+
+Boolean grammar (normalized; the pre-registry sites disagreed on "0" vs
+"" vs "1"): unset or empty string means "use the default"; "0", "false",
+"no", "off" (case-insensitive) mean False; anything else means True.
+
+Generate the docs table with:  python -m fishnet_tpu.utils.settings
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+PREFIX = "FISHNET_TPU_"
+
+_FALSE_WORDS = ("0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class Setting:
+    """One registered environment variable.
+
+    kind: "bool" | "int" | "str" | "csv-int" — drives the typed accessor
+    and the generated docs table. default is stored in string form ("":
+    no default / unset means None for str and csv-int kinds).
+    engine: True when the variable changes engine behavior or search
+    results and therefore must be forwarded to the supervised engine
+    host child (engine/supervisor.py applies engine_env() on spawn).
+    """
+
+    name: str
+    kind: str
+    default: str
+    doc: str
+    engine: bool = False
+
+
+# ---------------------------------------------------------------- registry
+#
+# PURE LITERALS ONLY in this tuple — the lint reads it via AST.
+
+SETTINGS: Tuple[Setting, ...] = (
+    Setting(
+        name="FISHNET_TPU_MAX_PLY",
+        kind="int",
+        default="32",
+        doc="Static search stack depth; compile cost scales with it. "
+            "Tests/CPU smoke runs set a small value.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_HELPERS",
+        kind="int",
+        default="4",
+        doc="Lazy-SMP helper lanes per analysed position "
+            "(engine/tpu.py); 1 disables helpers entirely.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_MAX_LANES",
+        kind="int",
+        default="1024",
+        doc="Per-dispatch lane ceiling (v5e VMEM cliff at ~1024 lanes, "
+            "docs/tpu-hang.md round 5).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_ASPIRATION",
+        kind="csv-int",
+        default="",
+        doc="Override aspiration window half-width schedule, e.g. "
+            "\"15,120\" (docs/depth.md: measured default).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_SELECT_UPDATES",
+        kind="bool",
+        default="1",
+        doc="Per-lane dynamic row writes as one-hot masked selects "
+            "(default) instead of scatter (docs/tpu-hang.md device "
+            "fault + 20x step cost; the modes are bit-identical).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_NO_PRUNING",
+        kind="bool",
+        default="0",
+        doc="Disable null-move pruning, LMR and futility pruning "
+            "(debug/A-B lever; the oracle mirrors the active mode).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_DTYPE",
+        kind="str",
+        default="",
+        doc="Quantize NNUE weights: \"bf16\" for MXU-native inputs; "
+            "\"int8\" is experimental and additionally gated.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_EXPERIMENTAL_INT8",
+        kind="bool",
+        default="0",
+        doc="Unlock the int8 fixed-point ladder (measured a NET LOSS "
+            "vs f32 at production shapes, round-5 bench).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_WARMUP_BUCKETS",
+        kind="csv-int",
+        default="",
+        doc="Trim the warmup lane-bucket set, e.g. \"16\" for CPU "
+            "smoke runs where each extra compile costs minutes.",
+    ),
+    Setting(
+        name="FISHNET_TPU_WARMUP_VARIANTS",
+        kind="str",
+        default="auto",
+        doc="Variant programs to precompile: comma list, \"all\", "
+            "\"none\", or \"auto\" (all on accelerators, none on CPU).",
+    ),
+    Setting(
+        name="FISHNET_TPU_TRACE",
+        kind="bool",
+        default="0",
+        doc="Per-dispatch / per-depth timing lines to stderr "
+            "(localize compile-vs-run cost from logs).",
+    ),
+    Setting(
+        name="FISHNET_TPU_COMPILE_CACHE",
+        kind="str",
+        default="",
+        doc="Persistent XLA compile cache directory "
+            "(default ~/.cache/fishnet-tpu/xla).",
+    ),
+    Setting(
+        name="FISHNET_TPU_NO_COMPILE_CACHE",
+        kind="bool",
+        default="0",
+        doc="Disable the persistent XLA compile cache entirely "
+            "(e.g. read-only filesystems).",
+    ),
+    Setting(
+        name="FISHNET_TPU_UPDATE_URL",
+        kind="str",
+        default="https://fishnet-tpu-releases.s3.amazonaws.com/",
+        doc="Release bucket for the auto-updater "
+            "(tests point it at a local fixture).",
+    ),
+)
+
+_BY_NAME: Dict[str, Setting] = {s.name: s for s in SETTINGS}
+
+
+class UnregisteredSetting(KeyError):
+    """A FISHNET_TPU_* name was used without a registry entry."""
+
+
+def lookup(name: str) -> Setting:
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise UnregisteredSetting(
+            f"{name} is not registered in fishnet_tpu/utils/settings.py"
+        ) from None
+
+
+def raw(name: str) -> Optional[str]:
+    """The raw environment value, or the registered default when unset
+    or empty. Returns None when there is no default either. Reads the
+    environment on every call — tests mutate it between imports."""
+    s = lookup(name)
+    value = os.environ.get(name)
+    if value is None or value == "":
+        value = s.default
+    return value if value != "" else None
+
+
+def get_bool(name: str) -> bool:
+    s = lookup(name)
+    if s.kind != "bool":
+        raise TypeError(f"{name} is registered as {s.kind}, not bool")
+    value = raw(name)
+    if value is None:
+        return False
+    return value.strip().lower() not in _FALSE_WORDS
+
+
+def get_int(name: str) -> int:
+    s = lookup(name)
+    if s.kind != "int":
+        raise TypeError(f"{name} is registered as {s.kind}, not int")
+    value = raw(name)
+    assert value is not None, f"{name} registered as int must have a default"
+    return int(value)
+
+
+def get_str(name: str) -> Optional[str]:
+    s = lookup(name)
+    if s.kind != "str":
+        raise TypeError(f"{name} is registered as {s.kind}, not str")
+    return raw(name)
+
+
+def get_csv_int(name: str) -> Optional[Tuple[int, ...]]:
+    """Comma-separated ints, or None when unset (callers keep their own
+    built-in fallback schedule)."""
+    s = lookup(name)
+    if s.kind != "csv-int":
+        raise TypeError(f"{name} is registered as {s.kind}, not csv-int")
+    value = raw(name)
+    if value is None:
+        return None
+    return tuple(int(x) for x in value.split(",") if x)
+
+
+def is_set(name: str) -> bool:
+    """True when the variable is explicitly present and non-empty in the
+    environment (regardless of defaults)."""
+    lookup(name)
+    return bool(os.environ.get(name))
+
+
+def engine_settings() -> Tuple[Setting, ...]:
+    return tuple(s for s in SETTINGS if s.engine)
+
+
+def engine_env() -> Dict[str, str]:
+    """Environment overlay carrying every engine-affecting variable that
+    is explicitly set, for the supervised engine host child. The child
+    would inherit the parent environment anyway; applying this overlay
+    explicitly makes the invariant visible — and statically checkable
+    (lint rule config-engine-wire) — so a future sanitized-env spawn
+    can't silently strand engine config on the parent side."""
+    out: Dict[str, str] = {}
+    for s in engine_settings():
+        value = os.environ.get(s.name)
+        if value:
+            out[s.name] = value
+    return out
+
+
+# ------------------------------------------------------------ docs table
+
+
+def render_rows(rows: List[tuple]) -> str:
+    """Render the docs/config.md table from (name, kind, default, doc,
+    engine) tuples. Shared by the runtime generator below and the lint's
+    AST-extracted staleness check, so the two can never disagree."""
+    lines = [
+        "# Configuration reference",
+        "",
+        "Every `FISHNET_TPU_*` environment variable, generated from the",
+        "single registry in `fishnet_tpu/utils/settings.py` — do not edit",
+        "by hand; regenerate with:",
+        "",
+        "```",
+        "python -m fishnet_tpu.utils.settings > docs/config.md",
+        "```",
+        "",
+        "Boolean grammar: unset/empty uses the default; `0`, `false`,",
+        "`no`, `off` (case-insensitive) mean false; anything else true.",
+        "Engine-affecting variables are forwarded to the supervised",
+        "engine host child on spawn (`settings.engine_env()`).",
+        "",
+        "| Variable | Type | Default | Engine-affecting | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for name, kind, default, doc, engine in rows:
+        default_cell = f"`{default}`" if default != "" else "*(unset)*"
+        lines.append(
+            f"| `{name}` | {kind} | {default_cell} | "
+            f"{'yes' if engine else 'no'} | {doc} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def render_config_md() -> str:
+    return render_rows(
+        [(s.name, s.kind, s.default, s.doc, s.engine) for s in SETTINGS]
+    )
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.stdout.write(render_config_md())

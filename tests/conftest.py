@@ -29,6 +29,14 @@ os.environ.setdefault("FISHNET_TPU_WARMUP_BUCKETS", "16")
 # constructs TpuEngine(helper_lanes=...) itself.
 os.environ.setdefault("FISHNET_TPU_HELPERS", "1")
 
+# make the package importable regardless of how pytest was invoked; the
+# settings registry (pure stdlib, safe before jax) is the single source
+# of truth for FISHNET_TPU_* reads — including the two below
+import sys as _sys
+
+_sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from fishnet_tpu.utils import settings  # noqa: E402
+
 # persistent XLA compile cache for the whole suite (VERDICT r4 weak #7:
 # the fast tier outgrew its box — XLA:CPU compiles of unchanged search
 # programs dominated its wall clock). Enabled below via jax.config (this
@@ -36,7 +44,7 @@ os.environ.setdefault("FISHNET_TPU_HELPERS", "1")
 # FISHNET_TPU_COMPILE_CACHE env var makes engine subprocesses (which call
 # utils.enable_compile_cache themselves) share the same directory.
 # Unchanged programs then compile once per code change, not once per run.
-if not os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
+if not settings.get_bool("FISHNET_TPU_NO_COMPILE_CACHE"):
     os.environ.setdefault(
         "FISHNET_TPU_COMPILE_CACHE",
         os.path.join(os.path.expanduser("~"), ".cache", "fishnet-tpu", "xla"),
@@ -48,11 +56,7 @@ try:
 
     _xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
-    if not os.environ.get("FISHNET_TPU_NO_COMPILE_CACHE"):
-        import sys as _sys
-
-        _sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
+    if not settings.get_bool("FISHNET_TPU_NO_COMPILE_CACHE"):
         from fishnet_tpu.utils import enable_compile_cache
 
         enable_compile_cache()

@@ -1,0 +1,554 @@
+"""fishnet-lint self-tests: fixture projects per rule family, the
+suppression/baseline mechanics, and the real-repo gate.
+
+The mutation tests are the teeth of the suite: they copy real source
+into a fixture tree, break an invariant the way a careless edit would
+(read an env var off-registry, drop a serde key), and assert the lint
+catches it. If a rule rots into always-green, these fail.
+"""
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from fishnet_tpu.lint import Project, dump_baseline, run_lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def make_project(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text, encoding="utf-8")
+    return Project.load(tmp_path)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------------- trace
+
+
+TRACED_BAD = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(x):
+    y = jnp.sum(x)
+    if y > 0:                    # trace-py-branch
+        y = y + 1
+    v = float(y)                 # trace-host-cast
+    w = y.item()                 # trace-host-item
+    z = np.sum(y)                # trace-np-mix
+    idx = jnp.arange(8)          # trace-int-dtype
+    return v + w + z + idx
+
+
+run = jax.jit(kernel)
+'''
+
+
+def test_trace_rules_fire_in_jit_wrapped_function(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/ops/bad.py": TRACED_BAD}
+    )
+    result = run_lint(project, only_families={"trace"})
+    assert rules_of(result.findings) == [
+        "trace-host-cast", "trace-host-item", "trace-int-dtype",
+        "trace-np-mix", "trace-py-branch",
+    ]
+
+
+def test_host_side_code_not_flagged(tmp_path):
+    # same calls, but nothing marks the function as traced: host drivers
+    # in kernel files legitimately call int()/.item()
+    host = TRACED_BAD.replace("run = jax.jit(kernel)", "run = kernel")
+    project = make_project(tmp_path, {"fishnet_tpu/ops/host.py": host})
+    result = run_lint(project, only_families={"trace"})
+    # file-scoped rules still apply; function-scoped ones must not
+    assert rules_of(result.findings) == ["trace-int-dtype"]
+
+
+def test_trace_propagates_through_call_graph(tmp_path):
+    src = '''
+import jax
+
+
+def helper(x):
+    return x.item()
+
+
+def kernel(x):
+    return helper(x)
+
+
+run = jax.jit(kernel)
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/prop.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert rules_of(result.findings) == ["trace-host-item"]
+
+
+def test_lax_hof_argument_is_traced(tmp_path):
+    src = '''
+from jax import lax
+
+
+def body(carry):
+    return carry.item()
+
+
+def cond(carry):
+    return carry < 4
+
+
+def drive(x):
+    return lax.while_loop(cond, body, x)
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/hof.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert rules_of(result.findings) == ["trace-host-item"]
+
+
+def test_trace_sync_flagged_and_suppressible(tmp_path):
+    src = '''
+import jax.numpy as jnp
+
+
+def bench(x):
+    x.block_until_ready()
+    # fishnet-lint: disable=trace-sync
+    x.block_until_ready()
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/sync.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert len(by_rule(result.findings, "trace-sync")) == 1
+
+
+def test_scope_excludes_non_kernel_files(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/notkernel.py": TRACED_BAD}
+    )
+    result = run_lint(project, only_families={"trace"})
+    assert result.findings == []
+
+
+# ------------------------------------------------------------------ config
+
+MINI_SETTINGS = '''
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Setting:
+    name: str
+    kind: str
+    default: str
+    doc: str
+    engine: bool = False
+
+
+SETTINGS: Tuple[Setting, ...] = (
+    Setting(name="FISHNET_TPU_MAX_PLY", kind="int", default="32",
+            doc="depth", engine=True),
+)
+'''
+
+
+def test_direct_env_read_flagged(tmp_path):
+    src = '''
+import os
+
+ply = os.environ.get("FISHNET_TPU_MAX_PLY", "32")
+foo = os.environ["FISHNET_TPU_FOO"]
+'''
+    project = make_project(tmp_path, {
+        "fishnet_tpu/utils/settings.py": MINI_SETTINGS,
+        "fishnet_tpu/engine/cfg.py": src,
+    })
+    result = run_lint(project, only_families={"config"})
+    assert len(by_rule(result.findings, "config-env-read")) == 2
+    # FISHNET_TPU_FOO additionally has no registry entry
+    unreg = by_rule(result.findings, "config-env-unregistered")
+    assert len(unreg) == 1 and "FISHNET_TPU_FOO" in unreg[0].message
+
+
+def test_registry_accessor_is_clean(tmp_path):
+    src = '''
+from ..utils import settings
+
+ply = settings.get_int("FISHNET_TPU_MAX_PLY")
+'''
+    project = make_project(tmp_path, {
+        "fishnet_tpu/utils/settings.py": MINI_SETTINGS,
+        "fishnet_tpu/engine/cfg.py": src,
+    })
+    result = run_lint(project, only_families={"config"})
+    assert by_rule(result.findings, "config-env-read") == []
+    assert by_rule(result.findings, "config-env-unregistered") == []
+
+
+def test_accessor_with_unregistered_name_flagged(tmp_path):
+    src = 'from ..utils import settings\n' \
+          'x = settings.get_bool("FISHNET_TPU_NOT_REGISTERED")\n'
+    project = make_project(tmp_path, {
+        "fishnet_tpu/utils/settings.py": MINI_SETTINGS,
+        "fishnet_tpu/engine/cfg.py": src,
+    })
+    result = run_lint(project, only_families={"config"})
+    assert len(by_rule(result.findings, "config-env-unregistered")) == 1
+
+
+def test_env_write_allowed_in_tests_not_in_package(tmp_path):
+    write = 'import os\nos.environ.setdefault("FISHNET_TPU_MAX_PLY", "8")\n'
+    project = make_project(tmp_path, {
+        "fishnet_tpu/utils/settings.py": MINI_SETTINGS,
+        "tests/conftest.py": write,
+        "fishnet_tpu/engine/cfg.py": write,
+    })
+    result = run_lint(project, only_families={"config"})
+    writes = by_rule(result.findings, "config-env-write")
+    assert len(writes) == 1
+    assert writes[0].path == "fishnet_tpu/engine/cfg.py"
+
+
+def test_doc_staleness(tmp_path):
+    from fishnet_tpu.utils.settings import render_rows
+
+    files = {"fishnet_tpu/utils/settings.py": MINI_SETTINGS}
+    project = make_project(tmp_path, files)
+    result = run_lint(project, only_families={"config"})
+    assert len(by_rule(result.findings, "config-doc-stale")) == 1  # missing
+
+    good = render_rows([("FISHNET_TPU_MAX_PLY", "int", "32", "depth", True)])
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "config.md").write_text(good, encoding="utf-8")
+    result = run_lint(Project.load(tmp_path), only_families={"config"})
+    assert by_rule(result.findings, "config-doc-stale") == []
+
+    (tmp_path / "docs" / "config.md").write_text(good + "edited\n",
+                                                 encoding="utf-8")
+    result = run_lint(Project.load(tmp_path), only_families={"config"})
+    assert len(by_rule(result.findings, "config-doc-stale")) == 1
+
+
+def test_non_literal_registry_flagged(tmp_path):
+    bad = MINI_SETTINGS.replace('default="32"', 'default=str(32)')
+    project = make_project(
+        tmp_path, {"fishnet_tpu/utils/settings.py": bad}
+    )
+    result = run_lint(project, only_families={"config"})
+    assert len(by_rule(result.findings, "config-registry-literal")) == 1
+
+
+def test_supervisor_must_wire_engine_env(tmp_path):
+    project = make_project(tmp_path, {
+        "fishnet_tpu/utils/settings.py": MINI_SETTINGS,
+        "fishnet_tpu/engine/supervisor.py":
+            "import os\n\n\ndef spawn():\n    return dict(os.environ)\n",
+    })
+    result = run_lint(project, only_families={"config"})
+    assert len(by_rule(result.findings, "config-engine-wire")) == 1
+
+    project = make_project(tmp_path, {
+        "fishnet_tpu/engine/supervisor.py":
+            "from ..utils import settings\n\n\ndef spawn():\n"
+            "    env = {}\n    env.update(settings.engine_env())\n"
+            "    return env\n",
+    })
+    result = run_lint(project, only_families={"config"})
+    assert by_rule(result.findings, "config-engine-wire") == []
+
+
+# -------------------------------------------------------------------- wire
+
+
+def _wire_fixture(tmp_path, mutate=None):
+    text = (REPO_ROOT / "fishnet_tpu/client/wire.py").read_text(
+        encoding="utf-8")
+    if mutate:
+        mutated = mutate(text)
+        assert mutated != text, "mutation did not apply"
+        text = mutated
+    return make_project(
+        tmp_path, {"fishnet_tpu/client/wire.py": text}
+    )
+
+
+def test_wire_clean_on_pristine_copy(tmp_path):
+    result = run_lint(_wire_fixture(tmp_path), only_families={"wire"})
+    assert result.findings == []
+
+
+def test_dropped_consumed_key_is_caught(tmp_path):
+    # a careless edit stops work_from_json reading "depth": the to-side
+    # still emits it → key asymmetry
+    def mutate(text):
+        return text.replace(
+            'depth=int(obj["depth"]) if obj.get("depth") is not None'
+            " else None,\n", "")
+
+    result = run_lint(_wire_fixture(tmp_path, mutate),
+                      only_families={"wire"})
+    asym = by_rule(result.findings, "wire-key-asymmetry")
+    assert len(asym) == 1 and "'depth'" in asym[0].message
+
+
+def test_new_field_without_serialization_is_caught(tmp_path):
+    def mutate(text):
+        return text.replace(
+            "    sf16: int\n",
+            "    sf16: int\n    flavor_hint: int = 0\n")
+
+    result = run_lint(_wire_fixture(tmp_path, mutate),
+                      only_families={"wire"})
+    missing = by_rule(result.findings, "wire-field-missing")
+    assert len(missing) == 1 and "flavor_hint" in missing[0].message
+
+
+def test_unknown_ctor_kwarg_is_caught(tmp_path):
+    def mutate(text):
+        return text.replace(
+            "wtime_centis=int(obj[\"wtime\"]),",
+            "wtime=int(obj[\"wtime\"]),")
+
+    result = run_lint(_wire_fixture(tmp_path, mutate),
+                      only_families={"wire"})
+    ctor = by_rule(result.findings, "wire-ctor-field-mismatch")
+    # 'wtime' is not a field, and required 'wtime_centis' is now missing
+    assert len(ctor) == 2
+
+
+def test_ipc_pairs_clean_on_real_repo():
+    project = Project.load(REPO_ROOT)
+    result = run_lint(project, only_families={"wire"})
+    assert result.findings == []
+
+
+# ------------------------------------------------------------- concurrency
+
+
+def test_no_timeout_rules(tmp_path):
+    src = '''
+import asyncio
+
+
+async def drain(q, proc, d):
+    a = q.get()                                    # flagged
+    b = q.get(timeout=1.0)                         # has timeout
+    c = d.get("key")                               # dict access
+    e = await asyncio.wait_for(proc.wait(), 5.0)   # wrapped
+    f = proc.wait()                                # flagged
+    return a, b, c, e, f
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    flagged = by_rule(result.findings, "conc-no-timeout")
+    assert [f.line for f in flagged] == [6, 10]
+
+
+def test_blocking_call_in_lock(tmp_path):
+    src = '''
+import time
+
+
+def step(lock, q, out):
+    with lock:
+        time.sleep(0.1)
+    with lock:
+        out.append(1)
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/host.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert len(by_rule(result.findings, "conc-block-in-lock")) == 1
+
+
+def test_except_rules(tmp_path):
+    src = '''
+def f(log):
+    try:
+        work()
+    except:                      # conc-bare-except (+ silent)
+        pass
+    try:
+        work()
+    except BaseException:        # conc-swallow-base (no re-raise)
+        cleanup()
+    try:
+        work()
+    except Exception:            # conc-silent-except
+        pass
+    try:
+        work()
+    except Exception as e:       # logs: clean
+        log.warn(f"failed: {e}")
+    try:
+        work()
+    except OSError:              # narrow: clean
+        pass
+    try:
+        work()
+    except BaseException:        # re-raises: clean
+        cleanup()
+        raise
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/helpers.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert len(by_rule(result.findings, "conc-bare-except")) == 1
+    assert len(by_rule(result.findings, "conc-swallow-base")) == 1
+    assert len(by_rule(result.findings, "conc-silent-except")) == 2
+
+
+# ------------------------------------------- suppressions, baseline, CLI
+
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    src = '''
+def f(q):
+    a = q.get()  # fishnet-lint: disable=conc-no-timeout
+    # fishnet-lint: disable=conc-no-timeout
+    b = q.get()
+    c = q.get()
+    return a, b, c
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert [f.line for f in by_rule(result.findings, "conc-no-timeout")] == [6]
+
+
+def test_baseline_absolves_and_goes_stale(tmp_path):
+    src = "def f(q):\n    return q.get()\n"
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert result.failed
+    baseline = [f.fingerprint() for f in result.findings]
+
+    result = run_lint(project, baseline=baseline,
+                      only_families={"concurrency"})
+    assert not result.failed
+    assert all(f.baselined for f in result.findings)
+
+    # fix the finding: the baseline entry is now stale
+    (tmp_path / "fishnet_tpu/client/queue.py").write_text(
+        "def f(q):\n    return q.get(timeout=1.0)\n", encoding="utf-8")
+    result = run_lint(Project.load(tmp_path), baseline=baseline,
+                      only_families={"concurrency"})
+    assert result.findings == [] and result.stale_baseline == baseline
+
+
+def test_dump_baseline_round_trips(tmp_path):
+    src = "def f(q):\n    return q.get()\n"
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    blob = json.loads(dump_baseline(result.findings))
+    assert blob["version"] == 1
+    assert blob["entries"] == [f.fingerprint() for f in result.findings]
+
+
+def test_cli_exit_codes(tmp_path):
+    from fishnet_tpu.lint.__main__ import main
+
+    make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py":
+                   "def f(q):\n    return q.get()\n"}
+    )
+    assert main(["--root", str(tmp_path)]) == 1
+    assert main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    assert main(["--root", str(tmp_path)]) == 0  # baselined now
+    assert main(["--list-rules"]) == 0
+
+
+def test_cli_github_format(tmp_path, capsys):
+    from fishnet_tpu.lint.__main__ import main
+
+    make_project(
+        tmp_path, {"fishnet_tpu/client/queue.py":
+                   "def f(q):\n    return q.get()\n"}
+    )
+    assert main(["--root", str(tmp_path), "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=fishnet_tpu/client/queue.py,line=2," in out
+
+
+# ------------------------------------------------------------ repo gates
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: the real repo lints clean."""
+    project = Project.load(REPO_ROOT)
+    baseline_path = REPO_ROOT / "lint-baseline.json"
+    baseline = []
+    if baseline_path.is_file():
+        from fishnet_tpu.lint import load_baseline
+
+        baseline = load_baseline(baseline_path)
+    result = run_lint(project, baseline=baseline)
+    assert not result.failed, "\n".join(
+        f.format_text() for f in result.active)
+    assert result.stale_baseline == []
+
+
+def test_baseline_has_no_config_or_wire_entries():
+    """Registry and serde findings must be FIXED, never baselined."""
+    baseline_path = REPO_ROOT / "lint-baseline.json"
+    if not baseline_path.is_file():
+        return
+    entries = json.loads(baseline_path.read_text())["entries"]
+    offenders = [e for e in entries
+                 if e.startswith(("config-", "wire-"))]
+    assert offenders == []
+
+
+def test_cli_entrypoint_runs():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fishnet_tpu.lint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_breaking_an_invariant_fails_the_gate(tmp_path):
+    """End-to-end mutation: copy the real settings + a consumer into a
+    fixture repo, add an off-registry env read, and watch the gate go
+    red."""
+    for rel in ("fishnet_tpu/utils/settings.py",):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO_ROOT / rel, dst)
+    (tmp_path / "docs").mkdir()
+    from fishnet_tpu.utils.settings import render_config_md
+
+    (tmp_path / "docs" / "config.md").write_text(render_config_md(),
+                                                 encoding="utf-8")
+    (tmp_path / "fishnet_tpu" / "rogue.py").write_text(
+        'import os\nFOO = os.environ.get("FISHNET_TPU_FOO")\n',
+        encoding="utf-8")
+    result = run_lint(Project.load(tmp_path), only_families={"config"})
+    assert result.failed
+    assert set(rules_of(result.active)) == {
+        "config-env-read", "config-env-unregistered",
+    }

@@ -1,0 +1,283 @@
+"""Round-trip property tests for every serde pair, generated from the
+dataclass fields themselves.
+
+The static wire-schema lint (fishnet_tpu/lint/wire_rules.py) proves the
+two sides of each pair mention the same fields and keys; these tests
+prove the *values* survive. The field lists are enumerated with
+`dataclasses.fields()` at run time, so adding a field to any wire
+dataclass automatically extends the suite — a new field with no value
+factory fails loudly instead of silently going untested.
+
+No JAX imports: this file stays in the sub-second tier.
+"""
+import dataclasses
+import time
+
+import pytest
+
+from fishnet_tpu.client.ipc import (
+    Chunk,
+    Matrix,
+    PositionResponse,
+    WorkPosition,
+    chunk_from_wire,
+    chunk_to_wire,
+    response_to_wire,
+    responses_from_wire,
+)
+from fishnet_tpu.client.wire import (
+    AnalysisWork,
+    Clock,
+    EngineFlavor,
+    MoveWork,
+    NodeLimit,
+    Score,
+    SkillLevel,
+    work_from_json,
+    work_to_json,
+)
+
+
+def _score_matrix(values):
+    m = Matrix()
+    for depth, v in enumerate(values, start=1):
+        m.set(1, depth, Score.cp(v))
+    return m
+
+
+def _pv_matrix(rows):
+    m = Matrix()
+    for depth, pv in enumerate(rows, start=1):
+        m.set(1, depth, list(pv))
+    return m
+
+
+# (base, alternate) per annotation string; the alternate must differ
+# from the base so a dropped field is guaranteed to change the output
+_BY_TYPE = {
+    "str": ("abc", "xyz"),
+    "int": (3, 7),
+    "float": (1.5, 2.25),
+    "bool": (True, False),
+    "Optional[int]": (2, 5),
+    "Optional[str]": ("u1", "u2"),
+    "List[str]": (["e2e4"], ["d2d4", "g8f6"]),
+    "NodeLimit": (NodeLimit(4000, 8000), NodeLimit(1000, 2000)),
+    "Optional[Clock]": (Clock(600, 600, 2), Clock(300, 300, 0)),
+    "SkillLevel": (SkillLevel(3), SkillLevel(5)),
+    "EngineFlavor": (EngineFlavor.TPU, EngineFlavor.OFFICIAL),
+    "Work": (
+        AnalysisWork(id="w1", nodes=NodeLimit(4000, 8000), timeout_s=6.0),
+        AnalysisWork(id="w2", nodes=NodeLimit(1000, 2000), timeout_s=3.0),
+    ),
+}
+
+# per-field overrides where the annotation alone is ambiguous (the two
+# Matrix fields carry different cell types)
+_BY_FIELD = {
+    ("PositionResponse", "scores"): (
+        _score_matrix([10, 25]), _score_matrix([-40])),
+    ("PositionResponse", "pvs"): (
+        _pv_matrix([["e2e4"], ["e2e4", "e7e5"]]), _pv_matrix([["d2d4"]])),
+    ("Chunk", "positions"): (None, None),  # built in the chunk factory
+    ("Chunk", "deadline"): (None, None),   # ttl-based, compared by slack
+}
+
+
+def _values_for(cls, f):
+    key = (cls.__name__, f.name)
+    if key in _BY_FIELD:
+        return _BY_FIELD[key]
+    ann = f.type if isinstance(f.type, str) else getattr(
+        f.type, "__name__", str(f.type))
+    if ann in _BY_TYPE:
+        return _BY_TYPE[ann]
+    pytest.fail(
+        f"no value factory for {cls.__name__}.{f.name}: {ann!r} — a new "
+        "wire field needs an entry here so the round-trip suite covers it"
+    )
+
+
+def canon(obj):
+    """Comparable structure; WorkPosition.work is dropped (rebuilt from
+    the chunk's work on the far side) and Chunk.deadline is compared
+    separately (monotonic-clock re-anchoring)."""
+    if isinstance(obj, Matrix):
+        return ("Matrix", canon(obj.matrix))
+    if isinstance(obj, EngineFlavor):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        skip = {"WorkPosition": {"work"}, "Chunk": {"deadline"}}.get(
+            type(obj).__name__, set())
+        return (type(obj).__name__, {
+            f.name: canon(getattr(obj, f.name))
+            for f in dataclasses.fields(obj) if f.name not in skip
+        })
+    if isinstance(obj, (list, tuple)):
+        return [canon(v) for v in obj]
+    return obj
+
+
+def _base_analysis():
+    return AnalysisWork(
+        id="batch01", nodes=NodeLimit(4000, 8000), timeout_s=6.0,
+        depth=None, multipv=None,
+    )
+
+
+def _base_move():
+    return MoveWork(id="batch02", level=SkillLevel(4), clock=None)
+
+
+def _base_chunk(work=None):
+    work = work or _base_analysis()
+    position = WorkPosition(
+        work=work, position_index=0, url=None, skip=False,
+        root_fen="rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1",
+        moves=["e2e4"],
+    )
+    return Chunk(
+        work=work, deadline=time.monotonic() + 30.0, variant="standard",
+        flavor=EngineFlavor.TPU, positions=[position],
+    )
+
+
+def _base_response():
+    return PositionResponse(
+        work=_base_analysis(), position_index=1, url=None,
+        scores=_score_matrix([15]), pvs=_pv_matrix([["e2e4"]]),
+        best_move="e2e4", depth=6, nodes=12345, time_s=0.25, nps=49380,
+    )
+
+
+def _rt_work(work):
+    return work_from_json(work_to_json(work))
+
+
+def _rt_chunk(chunk):
+    return chunk_from_wire(chunk_to_wire(chunk))
+
+
+def _rt_response(res):
+    out = responses_from_wire(res.work, [response_to_wire(res)])
+    assert len(out) == 1
+    return out[0]
+
+
+# ------------------------------------------------------------------- work
+
+
+@pytest.mark.parametrize("field", [
+    f.name for f in dataclasses.fields(AnalysisWork)])
+def test_analysis_work_field_roundtrip(field):
+    base = _base_analysis()
+    f = {f.name: f for f in dataclasses.fields(AnalysisWork)}[field]
+    _, alt = _values_for(AnalysisWork, f)
+    mutated = dataclasses.replace(base, **{field: alt})
+    assert canon(_rt_work(mutated)) == canon(mutated)
+
+
+@pytest.mark.parametrize("field", [
+    f.name for f in dataclasses.fields(MoveWork)])
+def test_move_work_field_roundtrip(field):
+    base = _base_move()
+    f = {f.name: f for f in dataclasses.fields(MoveWork)}[field]
+    _, alt = _values_for(MoveWork, f)
+    mutated = dataclasses.replace(base, **{field: alt})
+    assert canon(_rt_work(mutated)) == canon(mutated)
+
+
+def test_work_base_roundtrip():
+    assert canon(_rt_work(_base_analysis())) == canon(_base_analysis())
+    assert canon(_rt_work(_base_move())) == canon(_base_move())
+
+
+def test_nodelimit_and_clock_fields_covered():
+    # nested serde types ride inside the work pair; enumerate them too so
+    # a new NodeLimit/Clock field can't silently skip the suite
+    work = AnalysisWork(id="n", nodes=NodeLimit(111, 222), timeout_s=1.0)
+    assert canon(_rt_work(work).nodes) == canon(work.nodes)
+    for f in dataclasses.fields(NodeLimit):
+        assert f.type in ("int",), f"extend the suite for NodeLimit.{f.name}"
+    move = MoveWork(id="m", level=SkillLevel(2), clock=Clock(123, 456, 7))
+    assert canon(_rt_work(move).clock) == canon(move.clock)
+    for f in dataclasses.fields(Clock):
+        assert f.type in ("int",), f"extend the suite for Clock.{f.name}"
+
+
+# ------------------------------------------------------------------ chunk
+
+
+@pytest.mark.parametrize("field", [
+    f.name for f in dataclasses.fields(Chunk)])
+def test_chunk_field_roundtrip(field):
+    if field == "deadline":
+        chunk = _base_chunk()
+        ttl = chunk.deadline - time.monotonic()
+        rt = _rt_chunk(chunk)
+        assert abs((rt.deadline - time.monotonic()) - ttl) < 0.5
+        return
+    if field == "positions":
+        chunk = _base_chunk()
+        extra = WorkPosition(
+            work=chunk.work, position_index=None, url="http://x/1",
+            skip=True, root_fen="8/8/8/8/8/8/8/k1K5 w - - 0 1", moves=[],
+        )
+        mutated = dataclasses.replace(
+            chunk, positions=chunk.positions + [extra])
+        assert canon(_rt_chunk(mutated)) == canon(mutated)
+        return
+    chunk = _base_chunk()
+    f = {f.name: f for f in dataclasses.fields(Chunk)}[field]
+    _, alt = _values_for(Chunk, f)
+    mutated = dataclasses.replace(chunk, **{field: alt})
+    assert canon(_rt_chunk(mutated)) == canon(mutated)
+
+
+@pytest.mark.parametrize("field", [
+    f.name for f in dataclasses.fields(WorkPosition)
+    if f.name != "work"])  # rebuilt from the chunk's work by design
+def test_work_position_field_roundtrip(field):
+    chunk = _base_chunk()
+    f = {f.name: f for f in dataclasses.fields(WorkPosition)}[field]
+    _, alt = _values_for(WorkPosition, f)
+    mutated_pos = dataclasses.replace(chunk.positions[0], **{field: alt})
+    mutated = dataclasses.replace(chunk, positions=[mutated_pos])
+    assert canon(_rt_chunk(mutated)) == canon(mutated)
+
+
+def test_chunk_rebinds_position_work_to_chunk_work():
+    chunk = _base_chunk()
+    rt = _rt_chunk(chunk)
+    assert all(p.work is rt.work for p in rt.positions)
+
+
+# --------------------------------------------------------------- response
+
+
+@pytest.mark.parametrize("field", [
+    f.name for f in dataclasses.fields(PositionResponse)
+    if f.name != "work"])  # travels in the frame header, not the wire dict
+def test_response_field_roundtrip(field):
+    base = _base_response()
+    f = {f.name: f for f in dataclasses.fields(PositionResponse)}[field]
+    _, alt = _values_for(PositionResponse, f)
+    mutated = dataclasses.replace(base, **{field: alt})
+    assert canon(_rt_response(mutated)) == canon(mutated)
+
+
+def test_response_none_nps_roundtrip():
+    base = dataclasses.replace(_base_response(), nps=None)
+    assert _rt_response(base).nps is None
+
+
+# ------------------------------------------------------------------ score
+
+
+@pytest.mark.parametrize("score", [Score.cp(13), Score.cp(-200),
+                                   Score.mate(3), Score.mate(-1)])
+def test_score_roundtrip(score):
+    assert Score.from_json(score.to_json()) == score
+    for f in dataclasses.fields(Score):
+        assert f.name in ("kind", "value"), \
+            f"extend the suite for Score.{f.name}"

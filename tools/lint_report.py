@@ -1,0 +1,92 @@
+"""Human-oriented fishnet-lint report: findings grouped by rule with
+per-family counts, or GitHub workflow annotations.
+
+    python -m tools.lint_report                 # grouped summary
+    python -m tools.lint_report --format=github # ::error annotations
+    python -m tools.lint_report --all           # include baselined findings
+
+Exit code mirrors `python -m fishnet_tpu.lint`: 1 when active findings
+(or stale baseline entries) exist, else 0. The CLI in
+fishnet_tpu/lint/__main__.py stays the gate; this tool is the lens —
+one line per finding is the right shape for CI logs, but when a rule
+fires 30 times locally you want the grouping, not the scroll.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import List, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from fishnet_tpu.lint import Project, load_baseline, run_lint  # noqa: E402
+from fishnet_tpu.lint.__main__ import DEFAULT_BASELINE  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.lint_report",
+        description="fishnet-lint findings grouped by rule.",
+    )
+    parser.add_argument("--root", type=Path, default=REPO_ROOT)
+    parser.add_argument("--format", choices=("text", "github"),
+                        default="text")
+    parser.add_argument("--all", action="store_true",
+                        help="include baselined findings in the report")
+    args = parser.parse_args(argv)
+
+    root = args.root.resolve()
+    try:
+        project = Project.load(root)
+    except SyntaxError as e:
+        print(f"lint_report: {e}", file=sys.stderr)
+        return 2
+
+    baseline: List[str] = []
+    baseline_path = root / DEFAULT_BASELINE
+    if baseline_path.is_file():
+        baseline = load_baseline(baseline_path)
+    result = run_lint(project, baseline=baseline)
+
+    shown = result.findings if args.all else result.active
+
+    if args.format == "github":
+        for f in shown:
+            print(f.format_github())
+        for entry in result.stale_baseline:
+            print(f"::error title=fishnet-lint stale-baseline::stale "
+                  f"baseline entry (finding fixed?): {entry}")
+        return 1 if (result.failed or result.stale_baseline) else 0
+
+    by_rule = defaultdict(list)
+    for f in shown:
+        by_rule[f.rule].append(f)
+
+    for rule in sorted(by_rule):
+        findings = by_rule[rule]
+        print(f"{rule} ({len(findings)})")
+        for f in findings:
+            tag = " [baselined]" if f.baselined else ""
+            print(f"  {f.path}:{f.line}{tag}  {f.source_line.strip()}")
+        print()
+
+    families = defaultdict(int)
+    for f in shown:
+        families[f.rule.split("-", 1)[0]] += 1
+    summary = ", ".join(
+        f"{name}: {n}" for name, n in sorted(families.items())
+    ) or "clean"
+    print(f"fishnet-lint summary — {summary}")
+    if result.stale_baseline:
+        print(f"{len(result.stale_baseline)} stale baseline entries "
+              "(finding fixed? regenerate with --write-baseline):")
+        for entry in result.stale_baseline:
+            print(f"  {entry}")
+    return 1 if (result.failed or result.stale_baseline) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
