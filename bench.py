@@ -110,6 +110,139 @@ def _roots_for(B: int, variant: str, fen_set: str):
     )
 
 
+def _all_boards_for(B: int, variant: str, fen_set: str):
+    """The UNTRUNCATED workload for the refill comparison: every
+    root-move board of the multipv decomposition (229 for the standard
+    8-FEN set), or the fen set tiled to 2*B positions otherwise — more
+    positions than lanes is the regime continuous refill exists for."""
+    from fishnet_tpu.chess import Position
+    from fishnet_tpu.chess.variants import from_fen
+    from fishnet_tpu.ops.board import from_position, stack_boards
+
+    if fen_set == "960":
+        fens = FENS_960
+    elif fen_set == "variant":
+        fens = FENS_VARIANT[variant]
+    else:
+        fens = FENS_STANDARD
+    if variant == "standard":
+        positions = [Position.from_fen(f) for f in fens]
+    else:
+        positions = [from_fen(f, variant) for f in fens]
+    if fen_set == "multipv":
+        boards = []
+        for p in positions:
+            for m in p.legal_moves():
+                boards.append(from_position(p.push(m)))
+    else:
+        boards = [
+            from_position(positions[i % len(positions)])
+            for i in range(2 * B)
+        ]
+    return stack_boards(boards), len(boards)
+
+
+def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
+                  variant: str, fen_set: str, max_ply: int, tt,
+                  stream: bool, mode: str, platform: str,
+                  tt_log2: int, bench_dtype: str) -> None:
+    """Refill A/B stage (ISSUE 4): positions_done_per_s over the SAME
+    N-position workload at the SAME width B — chunk-serial width-B
+    batches drained one after another (stream=False, the
+    `_go_multiple_locked` regime) vs one full-width program whose DONE
+    lanes are respliced with queued positions at segment boundaries
+    (stream=True, ops/search.py search_stream). Occupancy counters land
+    in the RESULT JSON either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fishnet_tpu.ops import search as S
+
+    seg = int(os.environ.get("BENCH_SEG", "1024"))
+    roots, N = _all_boards_for(B, variant, fen_set)
+    depth_all = np.full(N, depth, np.int32)
+    budget_all = np.full(N, budget, np.int32)
+    _hb(t0, f"refill stage: N={N} positions, width={B}, "
+            f"mode={'stream' if stream else 'serial'}")
+
+    def serial_pass(tt):
+        """ceil(N/B) strictly-serial width-B dispatches; the last batch
+        runs mostly padding — exactly the chunk-drain waste refill
+        removes."""
+        done = 0
+        nodes = 0
+        for lo in range(0, N, B):
+            idx = np.arange(lo, min(lo + B, N))
+            pad = np.concatenate([idx, np.full(B - idx.size, idx[0])])
+            batch = jax.tree.map(lambda a: jnp.asarray(np.asarray(a)[pad]), roots)
+            d_arr = np.where(np.arange(B) < idx.size, depth, 0)
+            b_arr = np.where(np.arange(B) < idx.size, budget, 0)
+            out = S.search_batch_resumable(
+                params, batch,
+                d_arr.astype(np.int32), b_arr.astype(np.int32),
+                max_ply=max_ply, segment_steps=seg, tt=tt,
+                variant=variant,
+            )
+            tt = out.pop("tt")
+            jax.block_until_ready(out["nodes"])
+            done += int(np.asarray(out["done"])[: idx.size].sum())
+            nodes += int(np.asarray(out["nodes"])[: idx.size].sum())
+        return done, nodes, tt, None
+
+    def stream_pass(tt):
+        out = S.search_stream(
+            params, roots, depth_all, budget_all, max_ply=max_ply,
+            width=B, segment_steps=seg, tt=tt, variant=variant,
+        )
+        jax.block_until_ready(out["nodes"])
+        done = int(np.asarray(out["done"]).sum())
+        nodes = int(np.asarray(out["nodes"]).sum())
+        occ = out["occupancy"]
+        lane_steps = sum(o["steps"] * B for o in occ) or 1
+        live_steps = sum(o["steps"] * o["live"] for o in occ)
+        summary = {
+            "segments": len(occ),
+            "refills": out["refills"],
+            "mean_live_frac": round(live_steps / lane_steps, 4),
+        }
+        return done, nodes, out["tt"], summary
+
+    run = stream_pass if stream else serial_pass
+    _hb(t0, "exec_start warmup pass (compiles all programs)")
+    done, nodes, tt, occ = run(tt)
+    _hb(t0, f"exec_done warmup (done={done}/{N})")
+    _hb(t0, "exec_start timed pass")
+    t1 = time.perf_counter()
+    done, nodes, tt, occ = run(tt)
+    dt = time.perf_counter() - t1
+    _hb(t0, f"exec_done timed: done={done}/{N}, {nodes:,} nodes in {dt:.2f}s")
+    print(
+        "RESULT "
+        + json.dumps({
+            "nps": nodes / dt,
+            "B": B,
+            "depth": depth,
+            "nodes": nodes,
+            "dt": dt,
+            "platform": platform,
+            "variant": variant,
+            "fen_set": fen_set,
+            "row_mode": mode,
+            "max_ply": max_ply,
+            "positions": N,
+            "positions_done": done,
+            "positions_done_per_s": round(done / dt, 1),
+            "refill": "stream" if stream else "serial",
+            "occupancy": occ,
+            "net": os.environ.get("BENCH_NET", "random"),
+            "dtype": bench_dtype or "f32",
+            "tt_log2": tt_log2,
+        }),
+        flush=True,
+    )
+
+
 def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                fen_set: str = "standard") -> None:
     """Child process: run one (B, depth) stage with phase heartbeats.
@@ -206,6 +339,17 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
         from fishnet_tpu.ops import tt as tt_mod
 
         tt = tt_mod.make_table(tt_log2)
+
+    # BENCH_REFILL set → the refill A/B stage instead of the lockstep
+    # single-batch stage: same width, same workload (the FULL multipv
+    # decomposition, more positions than lanes), measured chunk-serial
+    # ("0") or streamed through the continuous-refill path ("1")
+    refill_env = os.environ.get("BENCH_REFILL", "")
+    if refill_env != "":
+        _bench_refill(t0, params, B, depth, budget, variant, fen_set,
+                      max_ply, tt, refill_env not in ("0", "false", "no"),
+                      mode, platform, tt_log2, bench_dtype)
+        return
     _hb(t0, "inputs built")
 
     # compile each program explicitly so a compiler hang is distinguishable
@@ -461,9 +605,25 @@ def main() -> None:
             ("production_d6_mp32", 192, 6, "standard", "multipv",
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
               "BENCH_TT_LOG2": "21"}),
+            # continuous lane refill A/B (round 7): the SAME production
+            # workload — all 229 root-move boards, MORE positions than
+            # the 192 lanes — drained chunk-serially in width-192 batches
+            # (the last batch runs 80% padding) vs streamed through one
+            # full-width program with DONE lanes respliced at segment
+            # boundaries (ops/search.py search_stream). Acceptance:
+            # refill-on positions_done_per_s >= 1.3x refill-off at the
+            # same width, with occupancy counters in the refill row.
+            # Ahead of helper_lanes_k4 (recorded in round 6) so a tight
+            # BENCH_TOTAL_BUDGET skips the rerun, not this round's A/B
+            ("production_d6_mp32_serial", 192, 6, "standard", "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "0"}),
+            ("production_d6_mp32_refill", 192, 6, "standard", "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1"}),
             # same production shape with 3 Lazy-SMP helper lanes riding
             # each of the 192 primaries (768 lanes total, shared 2M-slot
-            # TT): the acceptance comparison is this row's
+            # TT): the round-6 acceptance comparison is this row's
             # positions_done_per_s and completed depth vs
             # production_d6_mp32 at the same deadline
             ("helper_lanes_k4", 192, 6, "standard", "multipv",

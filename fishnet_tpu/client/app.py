@@ -68,6 +68,7 @@ def make_engine_factory(cfg: Config, logger: Logger):
                         weights_path=cfg.tpu_weights,
                         max_depth=cfg.tpu_depth,
                         helper_lanes=cfg.tpu_helpers,
+                        refill=cfg.tpu_refill,
                         logger=logger,
                     )
                 else:
@@ -77,6 +78,7 @@ def make_engine_factory(cfg: Config, logger: Logger):
                         weights_path=cfg.tpu_weights,
                         max_depth=cfg.tpu_depth,
                         helper_lanes=cfg.tpu_helpers,
+                        refill=cfg.tpu_refill,
                         logger=logger,
                     )
             # one device program (or supervised child) shared by all

@@ -96,6 +96,11 @@ class Config:
     # batch lanes re-search the hardest roots with perturbed ordering and
     # share results through the TT. 1 disables helpers entirely.
     tpu_helpers: int = 4
+    # continuous lane refill (engine/tpu.py LaneScheduler): finished
+    # lanes are respliced with queued positions at segment boundaries
+    # instead of narrowing and draining chunks serially; --no-tpu-refill
+    # restores strict chunk-serial dispatch
+    tpu_refill: bool = True
     # host the TPU engine in a supervised child process (engine/supervisor.py)
     # so a wedged device can be hard-killed and respawned; --no-supervisor
     # reverts to the in-process engine (debugging, single-process profiling)
@@ -144,6 +149,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu-depth", type=int, help="max search depth for the TPU engine")
     p.add_argument("--tpu-helpers", type=int,
                    help="Lazy-SMP helper lanes per position (1 disables)")
+    p.add_argument("--no-tpu-refill", action="store_true",
+                   help="disable continuous lane refill (strict "
+                        "chunk-serial engine dispatch)")
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the TPU engine in-process instead of in a "
                         "supervised child process")
@@ -210,6 +218,10 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.tpu_weights = pick(args.tpu_weights, "tpu_weights")
     cfg.tpu_depth = int(pick(args.tpu_depth, "tpu_depth", Config.tpu_depth))
     cfg.tpu_helpers = int(pick(args.tpu_helpers, "tpu_helpers", Config.tpu_helpers))
+    refill_ini = str(ini.get("tpu_refill", "")).strip().lower()
+    cfg.tpu_refill = not (
+        args.no_tpu_refill or refill_ini in ("0", "false", "no", "off")
+    )
     supervisor_ini = str(ini.get("supervisor", "")).strip().lower()
     cfg.supervisor = not (
         args.no_supervisor or supervisor_ini in ("0", "false", "no", "off")

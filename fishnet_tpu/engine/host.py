@@ -53,6 +53,7 @@ def _build_engine(args, log):
         weights_path=args.weights or None,
         max_depth=args.depth or 12,
         helper_lanes=args.helpers,
+        refill=None if args.refill is None else bool(args.refill),
     )
     if not args.skip_warmup:
         engine.warmup(None, log)
@@ -73,6 +74,9 @@ def main(argv=None) -> int:
     # Lazy-SMP lanes per analysed position (engine/tpu.py helper_lanes);
     # None defers to FISHNET_TPU_HELPERS / the engine default, 1 disables
     p.add_argument("--helpers", type=int, default=None)
+    # continuous lane refill (engine/tpu.py LaneScheduler); None defers
+    # to FISHNET_TPU_REFILL / the engine default, 0 disables
+    p.add_argument("--refill", type=int, default=None)
     p.add_argument("--hb-interval", type=float, default=1.0)
     p.add_argument("--skip-warmup", action="store_true")
     args = p.parse_args(argv)

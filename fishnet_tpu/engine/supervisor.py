@@ -58,6 +58,7 @@ def default_host_cmd(
     depth: Optional[int] = None,
     hb_interval: float = 1.0,
     helpers: Optional[int] = None,
+    refill: Optional[bool] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "fishnet_tpu.engine.host",
@@ -70,6 +71,9 @@ def default_host_cmd(
     if helpers is not None:
         # Lazy-SMP lane groups (engine/tpu.py helper_lanes); 1 disables
         cmd += ["--helpers", str(helpers)]
+    if refill is not None:
+        # continuous lane refill (engine/tpu.py LaneScheduler); 0 disables
+        cmd += ["--refill", "1" if refill else "0"]
     return cmd
 
 
@@ -112,6 +116,7 @@ class SupervisedEngine:
         weights_path: Optional[str] = None,
         max_depth: Optional[int] = None,
         helper_lanes: Optional[int] = None,
+        refill: Optional[bool] = None,
         logger: Optional[Logger] = None,
         hb_interval: float = 1.0,
         hb_timeout: Optional[float] = None,
@@ -125,7 +130,7 @@ class SupervisedEngine:
     ) -> None:
         self.host_cmd = host_cmd or default_host_cmd(
             backend=backend, weights=weights_path, depth=max_depth,
-            hb_interval=hb_interval, helpers=helper_lanes,
+            hb_interval=hb_interval, helpers=helper_lanes, refill=refill,
         )
         self.logger = logger or Logger()
         self.hb_interval = hb_interval

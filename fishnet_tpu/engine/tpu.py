@@ -26,6 +26,7 @@ from ..chess.variants import from_fen
 from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
 from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
+from ..ops import search as search_ops
 from ..ops.board import from_position, stack_boards
 from ..ops.search import INF, MATE, search_batch_resumable
 from ..utils import settings
@@ -140,6 +141,7 @@ class TpuEngine:
         tt_size_log2: int = 21,  # 2M slots ≈ 24 MiB HBM; 0 disables
         max_lanes: Optional[int] = None,  # single-dispatch lane ceiling
         helper_lanes: Optional[int] = None,  # Lazy-SMP lanes per position (K)
+        refill: Optional[bool] = None,  # continuous lane refill (LaneScheduler)
         logger=None,  # client Logger for operational warnings; stderr if None
     ) -> None:
         from ..utils import enable_compile_cache
@@ -242,6 +244,28 @@ class TpuEngine:
         # carry it so depth-preferred replacement never protects stale
         # entries from earlier chunks (ops/tt.py store)
         self._tt_gen = 0
+        # Continuous lane refill (continuous batching from LLM serving,
+        # Orca OSDI'22, mapped onto search lanes): single-pv analysis
+        # chunks flow through the LaneScheduler, which keeps one
+        # full-width compiled step busy by splicing queued positions
+        # into DONE lanes at segment boundaries instead of narrowing
+        # and draining chunks serially. Mesh-sharded lanes are not
+        # host-addressable per shard, so the scheduler only engages on
+        # single-device hosts (_go_multiple_sync checks at dispatch
+        # time); everything else takes the strict chunk-serial path,
+        # which stays bit-identical to the pre-refill engine.
+        if refill is None:
+            refill = settings.get_bool("FISHNET_TPU_REFILL")
+        self.refill = bool(refill)
+        self._scheduler = LaneScheduler(self)
+        # per-segment occupancy accounting (live/helper/idle lane
+        # counts, refill events), surfaced into bench rows and logs
+        self.occupancy_log: List[dict] = []
+        self.occupancy_totals = {
+            "segments": 0, "steps": 0, "lane_steps": 0,
+            "live_lane_steps": 0, "helper_lane_steps": 0,
+            "idle_lane_steps": 0, "refills": 0, "positions_done": 0,
+        }
         # per-delta aspiration accounting {delta: [windowed, fail_lo,
         # fail_hi, nodes]} — the measured basis for ASPIRATION_DELTAS
         # (see docs/depth.md §"Aspiration deltas, measured")
@@ -693,6 +717,19 @@ class TpuEngine:
         )
 
     def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
+        # single-pv analysis chunks flow through the occupancy-driven
+        # LaneScheduler when refill is on (and lanes are host-addressable,
+        # i.e. no mesh); every other shape takes the strict chunk-serial
+        # path UNCHANGED — with refill off the engine is bit-identical to
+        # the pre-refill code by construction (enforced by tests).
+        work = chunk.work
+        if (
+            self.refill
+            and self.mesh is None
+            and isinstance(work, AnalysisWork)
+            and work.effective_multipv() == 1
+        ):
+            return self._scheduler.run_chunk(chunk)
         with self._lock:
             return self._go_multiple_locked(chunk)
 
@@ -1232,3 +1269,542 @@ class TpuEngine:
                         for i in live
                     )
                 )
+
+
+# ---------------------------------------------- continuous lane refill
+
+
+class _RefillJob:
+    """One analysed position flowing through the LaneScheduler.
+
+    Carries its own iterative-deepening and aspiration-window state so
+    it progresses independently of every other position sharing the
+    batch — the per-lane decomposition of what `_analyse_single` +
+    `_search_windowed` track batch-wide. The per-depth policy here must
+    stay EXACTLY equivalent per lane (window schedule, fail-low/high
+    checks, budget charging), or refill-on scores drift from refill-off
+    ones with no TT involved."""
+
+    __slots__ = (
+        "entry", "wp", "pos", "board", "variant", "target_depth",
+        "remaining", "deadline", "hh", "hm", "depth", "delta_idx",
+        "prev_score", "have_prev", "hardness", "scores", "pvs",
+        "depth_reached", "best_move", "nodes_total", "nodes_depth",
+        "lane", "helpers",
+    )
+
+    def __init__(self, entry, wp, pos, board, variant, target_depth,
+                 budget, deadline, hh, hm):
+        self.entry = entry
+        self.wp = wp
+        self.pos = pos
+        self.board = board
+        self.variant = variant
+        self.target_depth = target_depth
+        self.remaining = budget  # node budget left (host int)
+        self.deadline = deadline
+        self.hh = hh  # (MAX_HIST, 2) repetition-history hashes
+        self.hm = hm  # (MAX_HIST,) halfmove distances
+        self.depth = 1  # depth currently being searched
+        self.delta_idx = 0  # index into ASPIRATION_DELTAS + (None,)
+        self.prev_score = 0
+        self.have_prev = False
+        self.hardness = 1  # previous depth's node count (helper planner)
+        self.scores = Matrix()
+        self.pvs = Matrix()
+        self.depth_reached = 0
+        self.best_move: Optional[str] = None
+        self.nodes_total = 0
+        self.nodes_depth = 0  # nodes across the current depth's attempts
+        self.lane = -1  # primary lane index while admitted
+        self.helpers: dict = {}  # helper lane index -> helper number h
+
+
+class _ChunkEntry:
+    """Per-chunk completion tracking shared between the submitting
+    thread and whichever thread is currently driving the device."""
+
+    def __init__(self, chunk: Chunk, started: float):
+        self.chunk = chunk
+        self.started = started
+        self.n_open = 0
+        self.responses: dict = {}  # position_index -> PositionResponse
+        self.error: Optional[str] = None
+        self.event = threading.Event()
+
+
+class LaneScheduler:
+    """Occupancy-driven scheduling of the lockstep search (ISSUE 4).
+
+    `_go_multiple_locked` drains chunks strictly serially and a batch
+    finishes when its HARDEST position does, so finished lanes idle —
+    masked but still stepping — until the power-of-two narrowing halves
+    the width. The scheduler applies iteration-level ("continuous")
+    batching instead: one pending-position queue fed by every
+    concurrently submitted single-pv analysis chunk, one full-width
+    compiled step, and at every segment boundary finished lanes are
+    refilled (ops/search.py refill_lanes) with queued positions,
+    earliest deadline first. Genuinely-spare lanes run Lazy-SMP helpers
+    (`_plan_helpers`), and each `PositionResponse` is emitted the moment
+    its position finishes rather than when its whole chunk does.
+
+    Concurrency (combining driver): any number of executor threads call
+    `run_chunk` concurrently. Each submits its positions to the shared
+    queue, then either becomes THE driver — taking the engine lock and
+    dispatching segments that serve everyone's jobs — or waits for its
+    responses. The engine lock is released between drive sessions so
+    move jobs and multipv chunks (which take the serial path) can
+    interleave. Per-admission TT generation tags flow into the (B,)
+    tt_gen array of `_run_segment_jit`, so depth-preferred replacement
+    never protects entries from an earlier occupant of the same lane."""
+
+    def __init__(self, engine: "TpuEngine"):
+        self.engine = engine
+        self._q_lock = threading.Lock()
+        self._pending: List[_RefillJob] = []
+        self._driving = False
+        self._jitter_seq = 0
+
+    # ------------------------------------------------------- submission
+
+    def run_chunk(self, chunk: Chunk) -> List[PositionResponse]:
+        entry = self._submit(chunk)
+        while not entry.event.is_set():
+            with self._q_lock:
+                drive = not self._driving
+                if drive:
+                    self._driving = True
+            if drive:
+                try:
+                    self._drive(entry)
+                finally:
+                    with self._q_lock:
+                        self._driving = False
+            else:
+                entry.event.wait(0.05)
+        if entry.error:
+            raise EngineError(entry.error)
+        return [entry.responses[wp.position_index] for wp in chunk.positions]
+
+    def _submit(self, chunk: Chunk) -> _ChunkEntry:
+        eng = self.engine
+        entry = _ChunkEntry(chunk, time.monotonic())
+        work = chunk.work
+        assert isinstance(work, AnalysisWork)
+        target_depth = min(
+            work.depth or eng.max_depth, eng.max_depth, MAX_PLY - 1
+        )
+        budget = work.nodes.get(chunk.flavor.eval_flavor())
+        per_pos_budget = budget if budget is not None else 10_000_000
+        variant = DEVICE_VARIANTS.get(chunk.variant, "standard")
+        deadline = chunk.deadline - 0.25  # slack to package results
+        jobs = []
+        for wp in chunk.positions:
+            pos = from_fen(wp.root_fen, chunk.variant)
+            game = []
+            for uci in wp.moves:
+                game.append(pos)
+                pos = pos.push(pos.parse_uci(uci))
+            if pos.outcome() is not None:
+                entry.responses[wp.position_index] = eng._terminal_response(
+                    chunk, wp, pos, 0.001
+                )
+                continue
+            hh, hm = TpuEngine._history_arrays([game], 1, variant)
+            jobs.append(_RefillJob(
+                entry, wp, pos, from_position(pos), variant, target_depth,
+                per_pos_budget, deadline, hh[0], hm[0],
+            ))
+        entry.n_open = len(jobs)
+        if not jobs:
+            entry.event.set()
+        with self._q_lock:
+            self._pending.extend(jobs)
+        return entry
+
+    def _finalize(self, job: _RefillJob, now: float,
+                  error: Optional[str] = None) -> None:
+        entry = job.entry
+        if error is not None:
+            entry.error = error
+        else:
+            dt = max(now - entry.started, 1e-6)
+            nps = int(job.nodes_total / dt) if job.nodes_total else None
+            entry.responses[job.wp.position_index] = PositionResponse(
+                work=entry.chunk.work, position_index=job.wp.position_index,
+                url=job.wp.url, scores=job.scores, pvs=job.pvs,
+                best_move=job.best_move, depth=job.depth_reached,
+                nodes=job.nodes_total, time_s=dt, nps=nps,
+            )
+            self.engine.occupancy_totals["positions_done"] += 1
+        entry.n_open -= 1
+        if entry.n_open <= 0:
+            entry.event.set()
+
+    # ---------------------------------------------------------- driving
+
+    def _drive(self, entry: _ChunkEntry) -> None:
+        while not entry.event.is_set():
+            with self._q_lock:
+                if not self._pending:
+                    return
+            # lock released between sessions: a blocked move job or
+            # multipv chunk gets the device before the next session
+            with self.engine._lock:
+                self._drive_session(entry)
+
+    def _drive_session(self, entry: _ChunkEntry) -> None:
+        """One fixed-width drive session: admit, dispatch segments,
+        process boundaries, until no lane is running. Jobs of OTHER
+        device variants stay queued (each variant is a distinct static
+        program); a later session picks them up."""
+        eng = self.engine
+        now = time.monotonic()
+        with self._q_lock:
+            if not self._pending:
+                return
+            self._pending.sort(key=lambda j: j.deadline)
+            variant = self._pending[0].variant
+            n_hint = sum(1 for j in self._pending if j.variant == variant)
+            filler = next(
+                j for j in self._pending if j.variant == variant
+            ).board
+        K = eng.helper_lanes
+        B = eng._helper_width(min(max(n_hint, 1), eng.max_lanes))
+        seg = settings.get_int("FISHNET_TPU_SEGMENT")
+        prefer_deep = K > 1 and eng.tt is not None
+        deltas = ASPIRATION_DELTAS + (None,)  # None = full window
+
+        # host-side lane tables
+        lane_job: List[Optional[_RefillJob]] = [None] * B  # primary owner
+        lane_owner: List[Optional[_RefillJob]] = [None] * B  # helper owner
+        lane_alpha = np.full(B, -INF, np.int64)
+        lane_beta = np.full(B, INF, np.int64)
+        gen = np.zeros(B, np.int32)
+        active: List[_RefillJob] = []
+
+        # idle base state: budget-0 lanes park in DONE within two steps;
+        # passing every optional init arg as a full array shares ONE
+        # _init_state_jit trace with refill_lanes' fresh states
+        from ..ops.search import HIST_HM_SENTINEL, MAX_HIST
+
+        state = search_ops._init_state_jit(
+            eng.params, stack_boards([filler] * B),
+            jnp.zeros(B, jnp.int32), jnp.zeros(B, jnp.int32),
+            MAX_PLY, variant,
+            hist_hash=jnp.zeros((B, MAX_HIST, 2), jnp.uint32),
+            hist_halfmove=jnp.full(
+                (B, MAX_HIST), HIST_HM_SENTINEL, jnp.int32
+            ),
+            root_alpha=jnp.full((B,), -INF, jnp.int32),
+            root_beta=jnp.full((B,), INF, jnp.int32),
+            order_jitter=jnp.zeros((B,), jnp.int32),
+            group=jnp.zeros((B,), jnp.int32),
+        )
+        tt = eng.tt
+
+        # admissions accumulated between boundaries, flushed as ONE
+        # refill_lanes call before each dispatch
+        adm: dict = {k: [] for k in (
+            "lane", "board", "depth", "budget", "alpha", "beta",
+            "jitter", "group", "hh", "hm",
+        )}
+
+        def window_for(job: _RefillJob, scale: int):
+            """Per-lane mirror of _search_windowed's window: narrow
+            around the previous depth's score, widening per failed
+            attempt, full-width first at depth 1 / after a mate score."""
+            use_win = (
+                job.have_prev
+                and abs(job.prev_score) < MATE - 1000
+                and job.depth >= 2
+            )
+            delta = deltas[min(job.delta_idx, len(deltas) - 1)]
+            if not use_win or delta is None:
+                return -INF, INF, None
+            return (
+                max(job.prev_score - delta * scale, -INF),
+                min(job.prev_score + delta * scale, INF),
+                delta,
+            )
+
+        def admit(lane, board, depth, budget, alpha, beta, jit, grp,
+                  hh, hm):
+            adm["lane"].append(lane)
+            adm["board"].append(board)
+            adm["depth"].append(depth)
+            adm["budget"].append(int(np.clip(budget, 1, 2**31 - 1)))
+            adm["alpha"].append(alpha)
+            adm["beta"].append(beta)
+            adm["jitter"].append(jit)
+            adm["group"].append(grp)
+            adm["hh"].append(hh)
+            adm["hm"].append(hm)
+            lane_alpha[lane] = alpha
+            lane_beta[lane] = beta
+            # fresh TT generation per admission: depth-preferred
+            # replacement must never protect the lane's previous
+            # occupant's entries (ops/tt.py store)
+            eng._tt_gen = (eng._tt_gen + 1) & 0x3FFFFFFF
+            gen[lane] = eng._tt_gen
+
+        def admit_primary(job: _RefillJob, lane: int):
+            job.lane = lane
+            lane_job[lane] = job
+            a, b, _delta = window_for(job, 1)
+            admit(lane, job.board, job.depth, job.remaining, a, b,
+                  0, lane, job.hh, job.hm)
+
+        def admit_helper(job: _RefillJob, lane: int, h: int):
+            # same layout as _analyse_single: odd h at the primary's
+            # depth (exact-depth TT entries consumable THIS iteration),
+            # even h one ply deeper; staggered window scale; nonzero
+            # unique jitter; group = primary lane
+            job.helpers[lane] = h
+            lane_owner[lane] = job
+            self._jitter_seq = (self._jitter_seq & 0xFFFF) + 1
+            a, b, _delta = window_for(job, 1 << min(h, 4))
+            d = min(job.depth + (1 - (h & 1)), job.target_depth)
+            admit(lane, job.board, d, job.remaining, a, b,
+                  self._jitter_seq, job.lane, job.hh, job.hm)
+
+        def release(job: _RefillJob, res: Optional[dict]):
+            """Free the job's primary + helper lanes; mid-flight helper
+            work is charged at its last-boundary node count (the work
+            actually spent against the position's budget — same honesty
+            rule as _analyse_single's helper charging)."""
+            if job.lane >= 0:
+                lane_job[job.lane] = None
+                job.lane = -1
+            for hl in list(job.helpers):
+                if res is not None:
+                    hn = int(res["nodes"][hl])
+                    job.nodes_total += hn
+                    job.remaining -= hn
+                lane_owner[hl] = None
+            job.helpers.clear()
+
+        def on_primary_done(job: _RefillJob, lane: int, res: dict,
+                            now: float):
+            """One primary lane parked in DONE: fail-low/high re-search,
+            next depth, or finalize — the per-lane equivalent of one
+            `_search_windowed` attempt boundary. The fail checks and the
+            widening schedule mirror that method exactly, so with no TT
+            a refilled lane's score chain is bit-identical to the
+            serial path's."""
+            score = int(res["score"][lane])
+            nodes = int(res["nodes"][lane])
+            job.nodes_depth += nodes
+            a_w = int(lane_alpha[lane])
+            b_w = int(lane_beta[lane])
+            fail_lo = score <= a_w and a_w > -INF
+            fail_hi = score >= b_w and b_w < INF
+            delta = deltas[min(job.delta_idx, len(deltas) - 1)]
+            if a_w > -INF or b_w < INF:
+                # same per-delta accounting as _search_windowed
+                st = eng.aspiration_stats.setdefault(delta, [0, 0, 0, 0])
+                st[0] += 1
+                st[1] += int(fail_lo)
+                st[2] += int(fail_hi)
+                st[3] += nodes
+            if (fail_lo or fail_hi) and delta is not None:
+                # re-search the same depth with the next wider window;
+                # the lane stays this job's — only its window changes
+                job.delta_idx += 1
+                a, b, _d = window_for(job, 1)
+                admit(lane, job.board, job.depth, job.remaining, a, b,
+                      0, lane, job.hh, job.hm)
+                return
+            # depth complete: record, charge the depth's nodes, advance
+            job.prev_score = score
+            job.have_prev = True
+            job.hardness = max(nodes, 1)
+            job.nodes_total += job.nodes_depth
+            job.remaining -= job.nodes_depth
+            job.nodes_depth = 0
+            job.delta_idx = 0
+            job.scores.set(1, job.depth, _score_from_int(score))
+            pv = [
+                _decode_uci(int(m))
+                for m in res["pv"][lane][: int(res["pv_len"][lane])]
+                if m >= 0
+            ]
+            job.pvs.set(1, job.depth, pv)
+            job.depth_reached = job.depth
+            mv = int(res["move"][lane])
+            job.best_move = _decode_uci(mv) if mv >= 0 else None
+            if (
+                job.depth >= job.target_depth
+                or job.remaining <= 0
+                or now >= job.deadline
+            ):
+                release(job, res)
+                active.remove(job)
+                self._finalize(job, now)
+                return
+            job.depth += 1
+            a, b, _d = window_for(job, 1)
+            admit(lane, job.board, job.depth, job.remaining, a, b,
+                  0, lane, job.hh, job.hm)
+
+        res: Optional[dict] = None
+        try:
+            while True:
+                now = time.monotonic()
+                # ---- reap jobs past their chunk deadline
+                for job in list(active):
+                    if now >= job.deadline:
+                        release(job, res)
+                        active.remove(job)
+                        if job.depth_reached == 0:
+                            # no usable result: fail the chunk so the
+                            # server reassigns it (same contract as the
+                            # serial path)
+                            self._finalize(
+                                job, now,
+                                error="chunk deadline expired before "
+                                      "depth 1 completed",
+                            )
+                        else:
+                            self._finalize(job, now)
+                # ---- admit pending positions, earliest deadline first
+                free = [
+                    i for i in range(B)
+                    if lane_job[i] is None and lane_owner[i] is None
+                ]
+                if not entry.event.is_set():
+                    with self._q_lock:
+                        self._pending.sort(key=lambda j: j.deadline)
+                        take: List[_RefillJob] = []
+                        for j in list(self._pending):
+                            if len(take) >= len(free):
+                                break
+                            if j.variant != variant:
+                                continue
+                            self._pending.remove(j)
+                            take.append(j)
+                    for job in take:
+                        if now >= job.deadline:
+                            self._finalize(
+                                job, now,
+                                error="chunk deadline expired before "
+                                      "depth 1 completed",
+                            )
+                            continue
+                        admit_primary(job, free.pop(0))
+                        active.append(job)
+                # ---- spend leftover free lanes on Lazy-SMP helpers
+                if K > 1 and tt is not None and free and active:
+                    n_act = len(active)
+                    cur = sum(len(j.helpers) for j in active)
+                    hardness = [
+                        j.hardness if j.remaining > 0 else 0
+                        for j in active
+                    ]
+                    plan = TpuEngine._plan_helpers(
+                        n_act, n_act + cur + len(free), K, hardness
+                    )
+                    want: dict = {}
+                    for r, _h in plan:
+                        want[r] = want.get(r, 0) + 1
+                    for r, job in enumerate(active):
+                        while free and len(job.helpers) < want.get(r, 0):
+                            admit_helper(
+                                job, free.pop(0), len(job.helpers) + 1
+                            )
+                # ---- flush this boundary's admissions in ONE splice
+                n_adm = len(adm["lane"])
+                if n_adm:
+                    state = search_ops.refill_lanes(
+                        eng.params, state, stack_boards(adm["board"]),
+                        adm["lane"],
+                        np.asarray(adm["depth"], np.int32),
+                        np.asarray(adm["budget"], np.int32),
+                        variant=variant,
+                        hist_hash=np.stack(adm["hh"]),
+                        hist_halfmove=np.stack(adm["hm"]),
+                        root_alpha=np.asarray(adm["alpha"], np.int32),
+                        root_beta=np.asarray(adm["beta"], np.int32),
+                        order_jitter=np.asarray(adm["jitter"], np.int32),
+                        group=np.asarray(adm["group"], np.int32),
+                    )
+                    for k in adm:
+                        adm[k].clear()
+                if not active:
+                    break  # nothing running; next session handles the rest
+                # ---- dispatch one segment
+                live_n = len(active)
+                helper_n = sum(len(j.helpers) for j in active)
+                t0 = time.monotonic()
+                state, tt, n = search_ops._run_segment_jit(
+                    eng.params, state, tt, seg, variant, False,
+                    prefer_deep, jnp.asarray(gen),
+                )
+                n = int(n)
+                with self._q_lock:
+                    q_len = len(self._pending)
+                self._record_occupancy(
+                    B, n, live_n, helper_n, n_adm, q_len,
+                    time.monotonic() - t0,
+                )
+                # ---- process finished lanes at the boundary
+                lane_done = np.asarray(
+                    state.lane[:, search_ops.LN_MODE] == search_ops.MODE_DONE
+                )
+                res = {
+                    k: np.asarray(v)
+                    for k, v in search_ops.extract_results(state, 0).items()
+                    if k != "steps"
+                }
+                now = time.monotonic()
+                # helper lanes that parked on their own: charge + free
+                for lane in range(B):
+                    job = lane_owner[lane]
+                    if job is not None and lane_done[lane]:
+                        hn = int(res["nodes"][lane])
+                        job.nodes_total += hn
+                        job.remaining -= hn
+                        del job.helpers[lane]
+                        lane_owner[lane] = None
+                # primary lanes that parked: aspiration verdict
+                for lane in range(B):
+                    job = lane_job[lane]
+                    if job is None or not lane_done[lane]:
+                        continue
+                    on_primary_done(job, lane, res, now)
+        except BaseException as e:
+            # the driver died mid-session (device fault, OOM...): fail
+            # every admitted job so no submitting thread waits forever
+            now = time.monotonic()
+            for job in active:
+                release(job, None)
+                self._finalize(job, now, error=f"tpu engine failed: {e}")
+            raise
+        finally:
+            eng.tt = tt
+
+    def _record_occupancy(self, width, steps, live, helpers, refilled,
+                          queue, wall):
+        eng = self.engine
+        tot = eng.occupancy_totals
+        idle = width - live - helpers
+        tot["segments"] += 1
+        tot["steps"] += steps
+        tot["lane_steps"] += steps * width
+        tot["live_lane_steps"] += steps * live
+        tot["helper_lane_steps"] += steps * helpers
+        tot["idle_lane_steps"] += steps * idle
+        tot["refills"] += refilled
+        eng.occupancy_log.append({
+            "segment": tot["segments"], "width": width, "steps": steps,
+            "live": live, "helpers": helpers, "idle": idle,
+            "refilled": refilled, "queue": queue,
+        })
+        if len(eng.occupancy_log) > 4096:
+            del eng.occupancy_log[:-4096]
+        if eng.trace:
+            eng.trace(
+                f"refill seg={tot['segments']} steps={steps} "
+                f"live={live}/{width} helpers={helpers} idle={idle} "
+                f"refilled={refilled} queue={queue} wall={wall:.3f}s"
+            )

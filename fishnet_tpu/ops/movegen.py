@@ -2,7 +2,8 @@
 
 Strategy (TPU-first, no data-dependent shapes): enumerate a fixed candidate
 space — (64 sq × 8 dirs × 7 steps) slider slots, (64×8) knight and king
-slots, (64×4) pawn slots, (64×3×4) promotion slots, 2 castling slots — as
+slots, (64×4) pawn slots, (8×3×4) promotion slots (promotions only
+originate from the 8 pre-promotion-rank squares), 2 castling slots — as
 masks, then compact valid candidates into a fixed (MAX_MOVES,) ORDERED move
 list with one single-array sort of packed (ordering_key << 16 | move)
 values (see generate_moves for the packing invariants). Legality is *not*
@@ -44,6 +45,14 @@ _TO1 = np.stack([np.clip(_SQ + 8, 0, 63), np.clip(_SQ - 8, 0, 63)])  # (2,64)
 _TO2 = np.stack([np.clip(_SQ + 16, 0, 63), np.clip(_SQ - 16, 0, 63)])
 _CAPS = np.asarray(T.PAWN_CAPTURES)  # (2, 64, 2), -1 padded
 _CSQ = np.clip(_CAPS, 0, 63)
+# promotion origin squares per color: white promotes from rank 6
+# (48..55), black from rank 1 (8..15). Restricting the promo candidate
+# section to these 8 rows shrinks the packed sort's input by 768-~96
+# slots (round-5 profile: the sort dominates the step) without losing
+# any candidate — promo_ok was identically False off these rows.
+_PROMO_FROM = np.stack(
+    [np.arange(48, 56, dtype=np.int32), np.arange(8, 16, dtype=np.int32)]
+)  # (2, 8)
 
 MAX_MOVES = T.MAX_MOVES
 # crazyhouse adds up to 5 droppable types × ≤64 empty squares on top of
@@ -86,9 +95,12 @@ def _hist_idx_tables(variant: str):
             [_TO1[c], _TO2[c], _CSQ[c][:, 0], _CSQ[c][:, 1]], axis=1
         )
         pw = (_SQ[:, None] | (pawn_tos << 6)).reshape(-1)
-        promo_tos = np.stack([_TO1[c], _CSQ[c][:, 0], _CSQ[c][:, 1]], axis=1)
+        pf = _PROMO_FROM[c]
+        promo_tos = np.stack(
+            [_TO1[c][pf], _CSQ[c][pf, 0], _CSQ[c][pf, 1]], axis=1
+        )
         pr = np.broadcast_to(
-            (_SQ[:, None] | (promo_tos << 6))[:, :, None], (64, 3, n_promo)
+            (pf[:, None] | (promo_tos << 6))[:, :, None], (8, 3, n_promo)
         ).reshape(-1)
         secs = [sl, kn, kg, pw, pr, np.zeros(2, np.int32)]
         if variant == "crazyhouse":
@@ -179,7 +191,7 @@ def _candidate_space(b: Board, variant: str = "standard"):
     Section order (mirrored by _hist_idx_tables; pinned by
     tests/test_device_board.py test_hist_index_tables_match_candidates):
     sliders (64,8,7), knights (64,8), king (64,8), pawns (64,4), promos
-    (64,3,n_promo), castling (2,), then crazyhouse drops (5,64)."""
+    (8,3,n_promo), castling (2,), then crazyhouse drops (5,64)."""
     board = b.board
     us = b.stm
     them = 1 - us
@@ -300,25 +312,34 @@ def _candidate_space(b: Board, variant: str = "standard"):
     all_iscap.append(is_cap)
 
     # promotions: [push, capL, capR] × 4 promo pieces (5 in antichess,
-    # which allows promotion to king)
-    promo_tos = jnp.stack([to1, csq[:, 0], csq[:, 1]], axis=1)  # (64, 3)
-    b_promo_tos = jnp.stack([b_to1, cpiece[:, 0], cpiece[:, 1]], axis=1)
+    # which allows promotion to king). Only the 8 pre-promotion-rank
+    # squares can promote, so the section gathers those rows through the
+    # _PROMO_FROM constant table (static per color → vectorized gather,
+    # same trick as _TO1/_CAPS) and the pre_promo factor — identically
+    # True on the selected rows — drops out. 768 → 8*3*n_promo sort slots.
+    def sel8(a):
+        return jnp.where(white, a[_PROMO_FROM[0]], a[_PROMO_FROM[1]])
+
+    promo_from = sel8(sq_idx)  # (8,)
+    to1_8, b_to1_8, to1_ok_8 = sel8(to1), sel8(b_to1), sel8(to1_ok)
+    csq_8, cpiece_8, cap_ok_8 = sel8(csq), sel8(cpiece), sel8(cap_ok)
+    promo_tos = jnp.stack([to1_8, csq_8[:, 0], csq_8[:, 1]], axis=1)  # (8, 3)
+    b_promo_tos = jnp.stack([b_to1_8, cpiece_8[:, 0], cpiece_8[:, 1]], axis=1)
     promo_ok_base = jnp.stack(
-        [to1_ok & pre_promo, cap_ok[:, 0] & pre_promo, cap_ok[:, 1] & pre_promo],
-        axis=1,
+        [to1_ok_8, cap_ok_8[:, 0], cap_ok_8[:, 1]], axis=1
     )
     promo_list = [T.PROMO_N, T.PROMO_B, T.PROMO_R, T.PROMO_Q]
     if variant == "antichess":
         promo_list.append(T.PROMO_K)
     promos = jnp.asarray(promo_list, dtype=jnp.int32)
     cands = (
-        sq_idx[:, None, None]
+        promo_from[:, None, None]
         | (promo_tos[:, :, None] << 6)
         | (promos[None, None, :] << 12)
     )
     valid = promo_ok_base[:, :, None] & jnp.ones((1, 1, len(promo_list)), bool)
     vict = jnp.maximum(piece_type(b_promo_tos), 0)[:, :, None]
-    is_cap = jnp.stack([jnp.zeros(64, bool), cap_ok[:, 0], cap_ok[:, 1]], axis=1)
+    is_cap = jnp.stack([jnp.zeros(8, bool), cap_ok_8[:, 0], cap_ok_8[:, 1]], axis=1)
     keys = _capture_key(
         jnp.broadcast_to(vict, cands.shape),
         jnp.zeros_like(cands),

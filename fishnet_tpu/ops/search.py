@@ -988,13 +988,263 @@ def extract_results(state: SearchState, steps) -> dict:
     }
 
 
+# ------------------------------------------------- continuous lane refill
+#
+# A lockstep step costs the same however many lanes are live, so a DONE
+# lane is pure waste until the batch narrows or the chunk drains — the
+# static-batching tax. The iteration-level scheduling fix from LLM
+# serving (continuous batching: when one sequence finishes, splice the
+# next request into its slot without relaunching the batch) maps
+# one-to-one onto lanes: at a segment boundary the host reinitializes
+# exactly the DONE lanes it wants to reuse — board rows, NNUE
+# accumulators, lane scalars, move/pv/history tables — while live
+# lanes' state is untouched bit-for-bit, and the SAME _run_segment_jit
+# program keeps running (refill changes array values, never shapes, so
+# there is no recompile). Per-lane TT generation tags stay host-side:
+# the caller passes a (B,) tt_gen array into _run_segment_jit, which
+# ops/tt.py broadcasts elementwise, so a refilled lane's stores carry
+# its own fresh generation without any tt.py change.
+
+
+def _merge_lanes(state: SearchState, fresh: SearchState,
+                 mask: jnp.ndarray) -> SearchState:
+    """Per-lane select between two same-shape states: lanes where mask
+    (B,) is True take `fresh`, the rest keep `state` — one fused masked
+    select per state field, no scatter."""
+    def pick(old, new):
+        m = mask.reshape((old.shape[0],) + (1,) * (old.ndim - 1))
+        return jnp.where(m, new, old)
+
+    return jax.tree.map(pick, state, fresh)
+
+
+_merge_lanes_jit = jax.jit(_merge_lanes)
+
+
+def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
+                 lane_idx, depth, node_budget, *, variant: str = "standard",
+                 hist_hash=None, hist_halfmove=None,
+                 root_alpha=None, root_beta=None,
+                 order_jitter=None, group=None) -> SearchState:
+    """Splice fresh root positions into selected lanes of a running state.
+
+    new_roots: batched Board with n rows; lane_idx: host sequence of n
+    distinct lane indices to reinitialize; depth/node_budget (n,) and the
+    optional per-lane arrays follow init_state semantics (None defaults
+    are expanded to the init_state defaults so every call shares ONE
+    _init_state_jit trace with the initial fill).
+
+    Lanes not in lane_idx keep their exact pre-call state — including
+    mid-segment stack contents, accumulators and history — so live
+    searches are unaffected. The caller is responsible for only
+    refilling DONE lanes and for bumping those lanes' TT generation
+    tags before the next _run_segment_jit dispatch."""
+    B = state.lane.shape[0]
+    max_ply = state.bt.shape[1] - 1
+    lane_idx = np.asarray(lane_idx, np.int64).reshape(-1)
+    n = int(lane_idx.shape[0])
+    if n == 0:
+        return state
+    take = np.zeros(B, np.int64)
+    take[lane_idx] = np.arange(n)
+    mask = np.zeros(B, bool)
+    mask[lane_idx] = True
+    tk = jnp.asarray(take)
+
+    def expand(x, fill, dtype, tail=()):
+        if x is None:
+            arr = np.full((n,) + tail, fill, dtype)
+        else:
+            arr = np.asarray(x)
+        return jnp.asarray(arr)[tk]
+
+    roots_full = jax.tree.map(lambda a: jnp.asarray(a)[tk], new_roots)
+    fresh = _init_state_jit(
+        params, roots_full,
+        expand(depth, 0, np.int32), expand(node_budget, 0, np.int32),
+        max_ply, variant,
+        hist_hash=expand(hist_hash, 0, np.uint32, (MAX_HIST, 2)),
+        hist_halfmove=expand(
+            hist_halfmove, HIST_HM_SENTINEL, np.int32, (MAX_HIST,)
+        ),
+        root_alpha=expand(root_alpha, -INF, np.int32),
+        root_beta=expand(root_beta, INF, np.int32),
+        order_jitter=expand(order_jitter, 0, np.int32),
+        group=expand(group, 0, np.int32),
+    )
+    return _merge_lanes_jit(state, fresh, jnp.asarray(mask))
+
+
+def search_stream(
+    params: nnue.NnueParams,
+    roots: Board,
+    depth,
+    node_budget,
+    max_ply: int,
+    width: int,
+    segment_steps: int | None = None,
+    max_steps: int = 50_000_000,
+    deadline: float | None = None,
+    tt=None,
+    variant: str = "standard",
+    hist=None,
+    prefer_deep_store: bool = False,
+    tt_gen_start: int = 1,
+):
+    """Stream N root positions through a fixed `width`-lane program.
+
+    The occupancy-driven counterpart of `search_batch_resumable`: instead
+    of narrowing as lanes finish, the host refills DONE lanes with queued
+    positions at every segment boundary, keeping the compiled step at
+    full width until the queue drains. Single-device only (a mesh shard
+    must keep its static width AND its lanes are not host-addressable
+    per-shard); the engine-level LaneScheduler adds helper lanes,
+    aspiration windows and per-position deadlines on top of the same
+    primitives.
+
+    Returns per-position (N,) results keyed as extract_results, plus:
+      occupancy: list of per-segment dicts {segment, steps, live, idle,
+                 refilled, queue} — live counts lanes still searching at
+                 the boundary, refilled the lanes spliced this boundary,
+                 idle = width - live - refilled.
+      refills:   total refill events (lanes spliced) across the run.
+    Positions not finished by deadline/max_steps report done=False.
+    """
+    import time as _time
+
+    if segment_steps is None:
+        segment_steps = settings.get_int("FISHNET_TPU_SEGMENT")
+    N = int(roots.stm.shape[0])
+    P = max_ply
+    depth = np.broadcast_to(np.asarray(depth, np.int32), (N,)).copy()
+    node_budget = np.broadcast_to(
+        np.asarray(node_budget, np.int32), (N,)
+    ).copy()
+    hist_hash, hist_halfmove = hist if hist is not None else (None, None)
+    if hist_hash is not None:
+        hist_hash = np.asarray(hist_hash)
+        hist_halfmove = np.asarray(hist_halfmove)
+
+    def gather_roots(pos_idx):
+        ix = jnp.asarray(np.asarray(pos_idx, np.int64))
+        return jax.tree.map(lambda a: jnp.asarray(a)[ix], roots)
+
+    def hist_rows(pos_idx):
+        if hist_hash is None:
+            return None, None
+        return hist_hash[pos_idx], hist_halfmove[pos_idx]
+
+    # initial admission: positions 0..k-1 into lanes 0..k-1; surplus
+    # lanes start with budget 0 so they park in DONE within two steps
+    lane_pos = np.full(width, -1, np.int64)
+    k = min(width, N)
+    lane_pos[:k] = np.arange(k)
+    queue = list(range(k, N))
+    take0 = np.where(lane_pos >= 0, lane_pos, 0)
+    assigned0 = lane_pos >= 0
+    hh0, hm0 = hist_rows(take0)
+    state = _init_state_jit(
+        params, gather_roots(take0),
+        jnp.asarray(np.where(assigned0, depth[take0], 0).astype(np.int32)),
+        jnp.asarray(
+            np.where(assigned0, node_budget[take0], 0).astype(np.int32)
+        ),
+        max_ply, variant,
+        hist_hash=jnp.asarray(
+            hh0 if hh0 is not None
+            else np.zeros((width, MAX_HIST, 2), np.uint32)
+        ),
+        hist_halfmove=jnp.asarray(
+            hm0 if hm0 is not None
+            else np.full((width, MAX_HIST), HIST_HM_SENTINEL, np.int32)
+        ),
+        root_alpha=jnp.full((width,), -INF, jnp.int32),
+        root_beta=jnp.full((width,), INF, jnp.int32),
+        order_jitter=jnp.zeros((width,), jnp.int32),
+        group=jnp.zeros((width,), jnp.int32),
+    )
+    gen = np.zeros(width, np.int32)
+    next_gen = int(tt_gen_start)
+    gen[assigned0] = np.arange(next_gen, next_gen + k, dtype=np.int32)
+    next_gen += k
+
+    out = {
+        "score": np.zeros(N, np.int32),
+        "move": np.full(N, -1, np.int32),
+        "pv": np.full((N, P), -1, np.int32),
+        "pv_len": np.zeros(N, np.int32),
+        "nodes": np.zeros(N, np.int32),
+    }
+    done_out = np.zeros(N, bool)
+    occupancy: list[dict] = []
+    refills_total = 0
+    total = 0
+    seg_i = 0
+    while total < max_steps:
+        if deadline is not None and _time.monotonic() >= deadline:
+            break
+        state, tt, n = _run_segment_jit(
+            params, state, tt, segment_steps, variant, False,
+            prefer_deep_store, jnp.asarray(gen),
+        )
+        total += int(n)
+        seg_i += 1
+        lane_done = np.asarray(state.lane[:, LN_MODE] == MODE_DONE)
+        res = extract_results(state, jnp.int32(total))
+        fin = np.nonzero(lane_done & (lane_pos >= 0))[0]
+        if fin.size:
+            for key in out:
+                out[key][lane_pos[fin]] = np.asarray(res[key])[fin]
+            done_out[lane_pos[fin]] = True
+            lane_pos[fin] = -1
+        live = int((lane_pos >= 0).sum())
+        free = np.nonzero(lane_pos < 0)[0]
+        n_ref = min(len(free), len(queue))
+        if n_ref and (deadline is None or _time.monotonic() < deadline):
+            take_pos = np.asarray(queue[:n_ref], np.int64)
+            del queue[:n_ref]
+            sel = free[:n_ref]
+            lane_pos[sel] = take_pos
+            gen[sel] = (
+                np.arange(next_gen, next_gen + n_ref) & 0x3FFFFFFF
+            ).astype(np.int32)
+            next_gen += n_ref
+            hh, hm = hist_rows(take_pos)
+            state = refill_lanes(
+                params, state, gather_roots(take_pos), sel,
+                depth[take_pos], node_budget[take_pos], variant=variant,
+                hist_hash=hh, hist_halfmove=hm,
+            )
+            refills_total += n_ref
+        occupancy.append({
+            "segment": seg_i, "steps": int(n), "live": live,
+            "refilled": int(n_ref),
+            "idle": width - live - int(n_ref), "queue": len(queue),
+        })
+        if live == 0 and n_ref == 0 and not queue:
+            break
+
+    return {
+        "score": jnp.asarray(out["score"]),
+        "move": jnp.asarray(out["move"]),
+        "pv": jnp.asarray(out["pv"]),
+        "pv_len": jnp.asarray(out["pv_len"]),
+        "nodes": jnp.asarray(out["nodes"]),
+        "done": jnp.asarray(done_out),
+        "steps": jnp.int32(total),
+        "occupancy": occupancy,
+        "refills": refills_total,
+        "tt": tt,
+    }
+
+
 def search_batch_resumable(
     params: nnue.NnueParams,
     roots: Board,
     depth,
     node_budget,
     max_ply: int,
-    segment_steps: int = 20_000,
+    segment_steps: int | None = None,
     max_steps: int = 4_000_000,
     deadline: float | None = None,
     tt=None,
@@ -1045,7 +1295,7 @@ def search_batch_resumable(
 
     narrow: at segment boundaries, retire DONE lanes and continue the
     live ones in a half-width program (repeatedly, power-of-two buckets,
-    floor 64). A lockstep step costs the same whether 1 or B lanes are
+    floor FISHNET_TPU_NARROW_FLOOR, default 64). A lockstep step costs the same whether 1 or B lanes are
     live, so the finish-tail otherwise dominates batch wall-clock (the
     round-5 bench measured 105 knps batch-completion vs 258 knps
     steady-state at B=1024 from exactly this). Off under a mesh (shards
@@ -1058,6 +1308,13 @@ def search_batch_resumable(
     re-search, never a wrong score).
     """
     import time as _time
+
+    # segment length and narrowing floor are registry-backed so deployments
+    # can trade host-check latency against dispatch overhead without code
+    # edits; the defaults reproduce the historical hardcoded values exactly
+    if segment_steps is None:
+        segment_steps = settings.get_int("FISHNET_TPU_SEGMENT")
+    narrow_floor = settings.get_int("FISHNET_TPU_NARROW_FLOOR")
 
     B = roots.stm.shape[0]
     depth = jnp.broadcast_to(jnp.asarray(depth, jnp.int32), (B,))
@@ -1124,15 +1381,16 @@ def search_batch_resumable(
         if deadline is not None and _time.monotonic() >= deadline:
             break
         cur = state.lane.shape[0]
-        if narrow and mesh is None and cur > 64:
+        if narrow and mesh is None and cur > narrow_floor:
             done = np.asarray(state.lane[:, LN_MODE] == MODE_DONE)
             live = int((~done & valid).sum())
-            # target width: smallest power of two >= live, floor 64 —
-            # always a power of two even when the caller's width is not
-            # (the engine pads >256-lane batches to multiples of 256),
-            # so narrowed programs land on the handful of pow2 shapes
-            # the compile cache / engine warmup already know
-            new_b = 64
+            # target width: smallest power of two >= live, floor
+            # FISHNET_TPU_NARROW_FLOOR (default 64) — always a power of
+            # two even when the caller's width is not (the engine pads
+            # >256-lane batches to multiples of 256), so narrowed
+            # programs land on the handful of pow2 shapes the compile
+            # cache / engine warmup already know
+            new_b = narrow_floor
             while new_b < live:
                 new_b *= 2
             if new_b < cur:
@@ -1182,8 +1440,8 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     """
     return search_batch_resumable(
         params, roots, depth, node_budget, max_ply=max_ply,
-        segment_steps=min(max_steps, 20_000), max_steps=max_steps,
-        tt=tt, variant=variant, hist=hist,
+        segment_steps=min(max_steps, settings.get_int("FISHNET_TPU_SEGMENT")),
+        max_steps=max_steps, tt=tt, variant=variant, hist=hist,
     )
 
 

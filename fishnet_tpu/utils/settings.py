@@ -84,6 +84,33 @@ SETTINGS: Tuple[Setting, ...] = (
         engine=True,
     ),
     Setting(
+        name="FISHNET_TPU_REFILL",
+        kind="bool",
+        default="1",
+        doc="Continuous lane refill: the engine keeps the compiled step "
+            "at full width by splicing queued positions into DONE lanes "
+            "at segment boundaries (engine/tpu.py LaneScheduler); 0 "
+            "restores strict chunk-serial dispatch.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_NARROW_FLOOR",
+        kind="int",
+        default="64",
+        doc="search_batch_resumable power-of-two narrowing floor: live "
+            "batches never narrow below this width (each width is a "
+            "separate XLA program).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_SEGMENT",
+        kind="int",
+        default="20000",
+        doc="Device steps per resumable segment between host checks "
+            "(deadline / narrowing / refill boundaries).",
+        engine=True,
+    ),
+    Setting(
         name="FISHNET_TPU_ASPIRATION",
         kind="csv-int",
         default="",

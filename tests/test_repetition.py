@@ -52,10 +52,12 @@ def test_repetition_draws_match_oracle(params):
         assert int(out["score"][i]) == exp["score"], fen
         assert int(out["nodes"][i]) == exp["nodes"], fen
         total_reps += exp["rep_hits"]
-    # the scenario must actually exercise the rule (NMP/LMR prune these
-    # shuffle trees hard — ~99 hits at depth 5 vs thousands unpruned —
-    # but dozens of hits still prove the rule fires)
-    assert total_reps > 50, f"only {total_reps} repetition hits"
+    # the scenario must actually exercise the rule. The pruning stack
+    # keeps shaving these shuffle trees: thousands of hits unpruned,
+    # ~99 after NMP/LMR (round 4), 13 after the measured aspiration-delta
+    # narrowing to (15,120) (round 6) — the score/node parity asserts
+    # above are the contract; this floor only proves the rule still fires
+    assert total_reps > 5, f"only {total_reps} repetition hits"
 
 
 def _shuffle_game(n_plies):

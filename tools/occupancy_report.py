@@ -1,0 +1,168 @@
+"""Replay a bench-like stage and report per-segment lane occupancy.
+
+Continuous lane refill (ops/search.py search_stream, round 7) keeps the
+compiled lockstep step at full width by resplicing DONE lanes with queued
+positions at segment boundaries. This tool makes that claim inspectable:
+it streams a multipv-style workload (more positions than lanes) through
+search_stream and prints a per-segment table of live / helper / idle lane
+counts plus the aggregate live-lane fraction — the same counters the
+engine's LaneScheduler logs per session (engine/tpu.py occupancy_totals).
+
+Usage:
+  python tools/occupancy_report.py --lanes 192 --depth 6 --tt-log2 21
+  python tools/occupancy_report.py --smoke            # fast CPU shape
+  python tools/occupancy_report.py --format=github    # ::warning below threshold
+
+--format=github emits a workflow warning annotation when the mean live
+fraction falls below --threshold (default 0.5): sustained low occupancy
+means the refill queue drained long before the stragglers finished, i.e.
+the stage is paying full-width step cost for mostly-idle lanes.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _boards(lanes: int, variant: str, cap: int | None = None):
+    """Every root-move board of the standard 8-FEN set (the production
+    multipv workload, 229 boards), tiled up if --lanes exceeds it —
+    the report needs MORE positions than lanes to exercise refill.
+    `cap` (the --smoke path) truncates the queue so CI pays for a
+    handful of refills, not the full production drain."""
+    from bench import FENS_STANDARD
+    from fishnet_tpu.chess import Position
+    from fishnet_tpu.ops.board import from_position, stack_boards
+
+    boards = []
+    for fen in FENS_STANDARD:
+        p = Position.from_fen(fen)
+        for m in p.legal_moves():
+            boards.append(from_position(p.push(m)))
+    floor = lanes + max(lanes // 4, 2)
+    while len(boards) < floor:
+        boards.append(boards[len(boards) % 229])
+    if cap is not None:
+        boards = boards[: max(cap, floor)]
+    return stack_boards(boards), len(boards)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, default=192)
+    ap.add_argument("--depth", type=int, default=6)
+    ap.add_argument("--budget", type=int, default=5_000_000)
+    ap.add_argument("--segment", type=int, default=None,
+                    help="segment steps (default: FISHNET_TPU_SEGMENT)")
+    ap.add_argument("--max-ply", type=int, default=32)
+    ap.add_argument("--tt-log2", type=int, default=21)
+    ap.add_argument("--net", choices=("random", "default"), default="default")
+    ap.add_argument("--threshold", type=float, default=0.5,
+                    help="annotate when mean live fraction is below this")
+    ap.add_argument("--format", choices=("text", "github"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="print a machine-readable summary line")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU shape for CI (8 lanes, depth 2, toy net)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        args.lanes, args.depth, args.max_ply = 8, 2, 6
+        args.budget, args.tt_log2, args.net = 50_000, 0, "random"
+        # segments must be shorter than a single toy search or every
+        # position finishes inside segment 1 and the live fraction reads
+        # as pure idle — 48 steps gives the smoke a real refill cadence.
+        # The straggler drain tail dominates a 10-position queue, so the
+        # production threshold would warn on every smoke run; the smoke
+        # gate is completion + accounting, not toy-shape occupancy
+        args.segment = args.segment or 48
+        args.threshold = min(args.threshold, 0.3)
+
+    import jax
+    import numpy as np
+
+    from fishnet_tpu.models import nnue
+    from fishnet_tpu.ops import search as S
+    from fishnet_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
+    if args.net == "default":
+        from fishnet_tpu.assets import load_default_params
+
+        params = load_default_params("board768")
+        if params is None:
+            raise RuntimeError("packaged net missing; use --net=random")
+    else:
+        params = nnue.init_params(
+            jax.random.PRNGKey(0), l1=64, feature_set="board768")
+
+    roots, n = _boards(args.lanes, "standard",
+                       cap=(args.lanes + max(args.lanes // 4, 2)
+                            if args.smoke else None))
+    depth = np.full(n, args.depth, np.int32)
+    budget = np.full(n, args.budget, np.int32)
+    tt = None
+    if args.tt_log2:
+        from fishnet_tpu.ops import tt as tt_mod
+
+        tt = tt_mod.make_table(args.tt_log2)
+
+    t0 = time.perf_counter()
+    out = S.search_stream(
+        params, roots, depth, budget, max_ply=args.max_ply,
+        width=args.lanes, segment_steps=args.segment, tt=tt,
+    )
+    jax.block_until_ready(out["nodes"])
+    wall = time.perf_counter() - t0
+
+    # ops-level rows: {segment, steps, live, refilled, idle, queue}
+    # (the engine's LaneScheduler adds helper counts on top of these)
+    occ = out["occupancy"]
+    lane_steps = sum(o["steps"] * args.lanes for o in occ) or 1
+    live_steps = sum(o["steps"] * (o["live"] + o["refilled"]) for o in occ)
+    mean_live = live_steps / lane_steps
+    done = int(np.asarray(out["done"]).sum())
+
+    print(f"{'seg':>4} {'steps':>6} {'live':>5} {'idle':>5} "
+          f"{'refill':>6} {'queue':>5}")
+    for o in occ:
+        print(f"{o['segment']:>4} {o['steps']:>6} {o['live']:>5} "
+              f"{o['idle']:>5} {o['refilled']:>6} {o['queue']:>5}")
+    print(f"positions {done}/{n} done, width {args.lanes}, "
+          f"{len(occ)} segments, {out['refills']} refills, "
+          f"mean live fraction {mean_live:.3f}, wall {wall:.2f}s")
+    if args.json:
+        print("OCCUPANCY " + json.dumps({
+            "lanes": args.lanes, "positions": n, "done": done,
+            "segments": len(occ), "refills": out["refills"],
+            "mean_live_frac": round(mean_live, 4),
+            "wall_s": round(wall, 3),
+        }))
+
+    if done < n:
+        msg = (f"only {done}/{n} positions finished — raise --budget or "
+               f"lower --depth")
+        if args.format == "github":
+            print(f"::error title=occupancy-report incomplete::{msg}")
+        else:
+            print(f"ERROR: {msg}")
+        return 1
+    if mean_live < args.threshold:
+        msg = (f"mean live lane fraction {mean_live:.3f} below threshold "
+               f"{args.threshold} — the refill queue drained long before "
+               f"the stragglers finished")
+        if args.format == "github":
+            print(f"::warning title=occupancy-report::{msg}")
+        else:
+            print(f"WARNING: {msg}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
