@@ -158,6 +158,7 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
     import numpy as np
 
     from fishnet_tpu.ops import search as S
+    from fishnet_tpu.utils import settings
 
     seg = int(os.environ.get("BENCH_SEG", "1024"))
     roots, N = _all_boards_for(B, variant, fen_set)
@@ -201,10 +202,21 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
         occ = out["occupancy"]
         lane_steps = sum(o["steps"] * B for o in occ) or 1
         live_steps = sum(o["steps"] * o["live"] for o in occ)
+        host_ms = sum(o["host_ms"] for o in occ)
+        device_ms = sum(o["device_ms"] for o in occ)
         summary = {
             "segments": len(occ),
             "refills": out["refills"],
             "mean_live_frac": round(live_steps / lane_steps, 4),
+            # segment-pipeline A/B columns (round 8): the host/device
+            # wall-clock split of every boundary interval and the
+            # transfer count (utils/syncstats.py via search_stream)
+            "host_ms": round(host_ms, 1),
+            "device_ms": round(device_ms, 1),
+            "boundary_share": round(
+                host_ms / max(host_ms + device_ms, 1e-9), 4),
+            "transfers": sum(o["transfers"] for o in occ),
+            "pipeline": int(settings.get_bool("FISHNET_TPU_PIPELINE")),
         }
         return done, nodes, out["tt"], summary
 
@@ -618,9 +630,26 @@ def main() -> None:
             ("production_d6_mp32_serial", 192, 6, "standard", "multipv",
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
               "BENCH_TT_LOG2": "21", "BENCH_REFILL": "0"}),
+            # FISHNET_TPU_PIPELINE pinned OFF: this row stays the
+            # round-7 synchronous-boundary baseline for the pipelined
+            # row below (same workload, same width, same refill path)
             ("production_d6_mp32_refill", 192, 6, "standard", "multipv",
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
-              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1"}),
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1",
+              "FISHNET_TPU_PIPELINE": "0"}),
+            # asynchronous segment pipeline A/B (round 8): identical
+            # stream workload with double-buffered dispatch — packed
+            # boundary summaries, donated segment buffers and
+            # speculative next-segment dispatch (ops/search.py
+            # search_stream pipeline=True). Compare host_ms /
+            # device_ms / transfers in the occupancy summary against
+            # the _refill row; acceptance is >=1.2x positions_done_per_s
+            # at the identical node total on the toy CPU shape
+            ("production_d6_mp32_pipelined", 192, 6, "standard",
+             "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1",
+              "FISHNET_TPU_PIPELINE": "1"}),
             # same production shape with 3 Lazy-SMP helper lanes riding
             # each of the 192 primaries (768 lanes total, shared 2M-slot
             # TT): the round-6 acceptance comparison is this row's

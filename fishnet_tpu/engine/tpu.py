@@ -30,6 +30,7 @@ from ..ops import search as search_ops
 from ..ops.board import from_position, stack_boards
 from ..ops.search import INF, MATE, search_batch_resumable
 from ..utils import settings
+from ..utils.syncstats import SegmentController, SyncStats
 from .base import EngineError
 
 # static stack depth; supports search depths up to MAX_PLY-1, with the
@@ -265,6 +266,10 @@ class TpuEngine:
             "segments": 0, "steps": 0, "lane_steps": 0,
             "live_lane_steps": 0, "helper_lane_steps": 0,
             "idle_lane_steps": 0, "refills": 0, "positions_done": 0,
+            # segment-boundary cost split (utils/syncstats.py): wall-clock
+            # the host spent blocked on device results vs doing boundary
+            # bookkeeping, plus the host-device transfer count
+            "host_ms": 0.0, "device_ms": 0.0, "transfers": 0,
         }
         # per-delta aspiration accounting {delta: [windowed, fail_lo,
         # fail_hi, nodes]} — the measured basis for ASPIRATION_DELTAS
@@ -383,7 +388,6 @@ class TpuEngine:
             variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
         else:
             variants = [v for v in env.split(",") if v]
-        scratch = self._scratch_tt()
         for variant in variants:
             # 16 lanes / exact-depth probes: analysis chunks.
             # _move_job_floor lanes / deep-bounds probes: move-job
@@ -411,7 +415,10 @@ class TpuEngine:
                 self._search(
                     roots, np.ones(b, np.int32), np.full(b, 64, np.int32),
                     variant=variant, deep_tt=deep,
-                    tt_override=scratch,
+                    # a fresh scratch per dispatch: segment dispatches
+                    # DONATE the table (ops/search.py), so a shared
+                    # scratch would be consumed by the first search
+                    tt_override=self._scratch_tt(),
                     # analysis dispatches run the helper-mode program
                     # when helper lanes are on; move jobs stay plain
                     helper_store=(not deep) and self.helper_lanes > 1,
@@ -1471,7 +1478,16 @@ class LaneScheduler:
             ).board
         K = eng.helper_lanes
         B = eng._helper_width(min(max(n_hint, 1), eng.max_lanes))
-        seg = settings.get_int("FISHNET_TPU_SEGMENT")
+        seg = settings.get_segment()
+        ctrl = None
+        if seg is None:  # FISHNET_TPU_SEGMENT=auto
+            ctrl = SegmentController(
+                settings.get_int("FISHNET_TPU_SEGMENT_MIN"),
+                settings.get_int("FISHNET_TPU_SEGMENT_MAX"),
+            )
+            seg = ctrl.steps
+        pipeline = settings.get_bool("FISHNET_TPU_PIPELINE")
+        stats = SyncStats()
         prefer_deep = K > 1 and eng.tt is not None
         deltas = ASPIRATION_DELTAS + (None,)  # None = full window
 
@@ -1568,17 +1584,18 @@ class LaneScheduler:
             admit(lane, job.board, d, job.remaining, a, b,
                   self._jitter_seq, job.lane, job.hh, job.hm)
 
-        def release(job: _RefillJob, res: Optional[dict]):
+        def release(job: _RefillJob, nodes_row):
             """Free the job's primary + helper lanes; mid-flight helper
-            work is charged at its last-boundary node count (the work
-            actually spent against the position's budget — same honesty
+            work is charged at its last-boundary node count (nodes_row:
+            the latest boundary's (B,) per-lane node counts — the work
+            actually spent against the position's budget, same honesty
             rule as _analyse_single's helper charging)."""
             if job.lane >= 0:
                 lane_job[job.lane] = None
                 job.lane = -1
             for hl in list(job.helpers):
-                if res is not None:
-                    hn = int(res["nodes"][hl])
+                if nodes_row is not None:
+                    hn = int(nodes_row[hl])
                     job.nodes_total += hn
                     job.remaining -= hn
                 lane_owner[hl] = None
@@ -1638,7 +1655,7 @@ class LaneScheduler:
                 or job.remaining <= 0
                 or now >= job.deadline
             ):
-                release(job, res)
+                release(job, res["nodes"])
                 active.remove(job)
                 self._finalize(job, now)
                 return
@@ -1647,131 +1664,372 @@ class LaneScheduler:
             admit(lane, job.board, job.depth, job.remaining, a, b,
                   0, lane, job.hh, job.hm)
 
+        # pipelined boundary state: PV pulls deferred past speculative
+        # boundaries as (job, lane, depth, final) — the PV row is the
+        # one per-lane result NOT in the packed summary
+        pv_pending: List[tuple] = []
+        last_device_s = 0.0
+
+        def q_len_locked() -> int:
+            with self._q_lock:
+                return len(self._pending)
+
+        def dispatch(st, table, n_steps):
+            # donates st and table (ops/search.py): both handles are
+            # dead after this call — always rebind to the outputs
+            return search_ops._run_segment_jit(
+                eng.params, st, table, n_steps, variant, False,
+                prefer_deep, jnp.asarray(gen),
+            )
+
+        def on_primary_parked(job: _RefillJob, lane: int, score: int,
+                              move: int, nodes: int, nodes_row,
+                              now: float):
+            """Summary-only twin of on_primary_done for the pipelined
+            loop: the aspiration verdict and all bookkeeping come from
+            the packed boundary summary; the PV row is deferred to
+            flush_pv, which reads it from the next RESOLVED state —
+            legal because a DONE lane is frozen until the refill splice
+            that flush_pv always precedes."""
+            job.nodes_depth += nodes
+            a_w = int(lane_alpha[lane])
+            b_w = int(lane_beta[lane])
+            fail_lo = score <= a_w and a_w > -INF
+            fail_hi = score >= b_w and b_w < INF
+            delta = deltas[min(job.delta_idx, len(deltas) - 1)]
+            if a_w > -INF or b_w < INF:
+                st = eng.aspiration_stats.setdefault(delta, [0, 0, 0, 0])
+                st[0] += 1
+                st[1] += int(fail_lo)
+                st[2] += int(fail_hi)
+                st[3] += nodes
+            if (fail_lo or fail_hi) and delta is not None:
+                job.delta_idx += 1
+                a, b, _d = window_for(job, 1)
+                admit(lane, job.board, job.depth, job.remaining, a, b,
+                      0, lane, job.hh, job.hm)
+                return
+            job.prev_score = score
+            job.have_prev = True
+            job.hardness = max(nodes, 1)
+            job.nodes_total += job.nodes_depth
+            job.remaining -= job.nodes_depth
+            job.nodes_depth = 0
+            job.delta_idx = 0
+            job.scores.set(1, job.depth, _score_from_int(score))
+            job.depth_reached = job.depth
+            job.best_move = _decode_uci(move) if move >= 0 else None
+            final = (
+                job.depth >= job.target_depth
+                or job.remaining <= 0
+                or now >= job.deadline
+            )
+            pv_pending.append((job, lane, job.depth, final))
+            if final:
+                release(job, nodes_row)
+                active.remove(job)
+                return  # _finalize waits in flush_pv for the PV row
+            job.depth += 1
+            a, b, _d = window_for(job, 1)
+            admit(lane, job.board, job.depth, job.remaining, a, b,
+                  0, lane, job.hh, job.hm)
+
+        def flush_pv(st, now: float):
+            """Materialize deferred PV rows with two small device-side
+            gathers from a resolved state, then finalize the jobs whose
+            response waited only on the PV. Must run BEFORE flush_adm:
+            a refill splice resets the spliced lanes' PV tables."""
+            if not pv_pending:
+                return
+            rows = jnp.asarray(
+                np.asarray([e[1] for e in pv_pending], np.int64)
+            )
+            pv_rows = stats.fetch(
+                jnp.take(st.pv[:, 0], rows, axis=0), "pv"
+            )
+            pv_lens = stats.fetch(
+                jnp.take(st.nt[:, 0, search_ops.NT_PVLEN], rows, axis=0),
+                "pv_len",
+            )
+            for i, (job, _lane, depth, final) in enumerate(pv_pending):
+                pv = [
+                    _decode_uci(int(m))
+                    for m in pv_rows[i][: int(pv_lens[i])]
+                    if m >= 0
+                ]
+                job.pvs.set(1, depth, pv)
+                if final:
+                    self._finalize(job, now)
+            pv_pending.clear()
+
+        def reap_jobs(now: float, nodes_row):
+            # ---- reap jobs past their chunk deadline
+            for job in list(active):
+                if now >= job.deadline:
+                    release(job, nodes_row)
+                    active.remove(job)
+                    if pv_pending:
+                        # the response built below holds job.pvs BY
+                        # REFERENCE: a deferred pull landing after it
+                        # would mutate an already-sent response
+                        pv_pending[:] = [
+                            e for e in pv_pending if e[0] is not job
+                        ]
+                    if job.depth_reached == 0:
+                        # no usable result: fail the chunk so the
+                        # server reassigns it (same contract as the
+                        # serial path)
+                        self._finalize(
+                            job, now,
+                            error="chunk deadline expired before "
+                                  "depth 1 completed",
+                        )
+                    else:
+                        self._finalize(job, now)
+
+        def admit_new(now: float):
+            # ---- admit pending positions, earliest deadline first
+            free = [
+                i for i in range(B)
+                if lane_job[i] is None and lane_owner[i] is None
+            ]
+            if not entry.event.is_set():
+                with self._q_lock:
+                    self._pending.sort(key=lambda j: j.deadline)
+                    take: List[_RefillJob] = []
+                    for j in list(self._pending):
+                        if len(take) >= len(free):
+                            break
+                        if j.variant != variant:
+                            continue
+                        self._pending.remove(j)
+                        take.append(j)
+                for job in take:
+                    if now >= job.deadline:
+                        self._finalize(
+                            job, now,
+                            error="chunk deadline expired before "
+                                  "depth 1 completed",
+                        )
+                        continue
+                    admit_primary(job, free.pop(0))
+                    active.append(job)
+            # ---- spend leftover free lanes on Lazy-SMP helpers
+            if K > 1 and tt is not None and free and active:
+                n_act = len(active)
+                cur = sum(len(j.helpers) for j in active)
+                hardness = [
+                    j.hardness if j.remaining > 0 else 0
+                    for j in active
+                ]
+                plan = TpuEngine._plan_helpers(
+                    n_act, n_act + cur + len(free), K, hardness
+                )
+                want: dict = {}
+                for r, _h in plan:
+                    want[r] = want.get(r, 0) + 1
+                for r, job in enumerate(active):
+                    while free and len(job.helpers) < want.get(r, 0):
+                        admit_helper(
+                            job, free.pop(0), len(job.helpers) + 1
+                        )
+
+        def flush_adm(st):
+            # ---- flush staged admissions in ONE refill splice (donates
+            # st — rebind to the return value)
+            n_adm = len(adm["lane"])
+            if not n_adm:
+                return st, 0
+            st = search_ops.refill_lanes(
+                eng.params, st, stack_boards(adm["board"]),
+                adm["lane"],
+                np.asarray(adm["depth"], np.int32),
+                np.asarray(adm["budget"], np.int32),
+                variant=variant,
+                hist_hash=np.stack(adm["hh"]),
+                hist_halfmove=np.stack(adm["hm"]),
+                root_alpha=np.asarray(adm["alpha"], np.int32),
+                root_beta=np.asarray(adm["beta"], np.int32),
+                order_jitter=np.asarray(adm["jitter"], np.int32),
+                group=np.asarray(adm["group"], np.int32),
+            )
+            for k in adm:
+                adm[k].clear()
+            return st, n_adm
+
         res: Optional[dict] = None
         try:
-            while True:
-                now = time.monotonic()
-                # ---- reap jobs past their chunk deadline
-                for job in list(active):
-                    if now >= job.deadline:
-                        release(job, res)
-                        active.remove(job)
-                        if job.depth_reached == 0:
-                            # no usable result: fail the chunk so the
-                            # server reassigns it (same contract as the
-                            # serial path)
-                            self._finalize(
-                                job, now,
-                                error="chunk deadline expired before "
-                                      "depth 1 completed",
-                            )
-                        else:
-                            self._finalize(job, now)
-                # ---- admit pending positions, earliest deadline first
-                free = [
-                    i for i in range(B)
-                    if lane_job[i] is None and lane_owner[i] is None
-                ]
-                if not entry.event.is_set():
-                    with self._q_lock:
-                        self._pending.sort(key=lambda j: j.deadline)
-                        take: List[_RefillJob] = []
-                        for j in list(self._pending):
-                            if len(take) >= len(free):
-                                break
-                            if j.variant != variant:
-                                continue
-                            self._pending.remove(j)
-                            take.append(j)
-                    for job in take:
-                        if now >= job.deadline:
-                            self._finalize(
-                                job, now,
-                                error="chunk deadline expired before "
-                                      "depth 1 completed",
-                            )
+            if not pipeline:
+                # round-7 synchronous loop (FISHNET_TPU_PIPELINE=0):
+                # block on the segment, materialize the full result
+                # set, refill, repeat — kept bit-for-bit as the A/B
+                # baseline, instrumented through SyncStats
+                while True:
+                    now = time.monotonic()
+                    reap_jobs(
+                        now, res["nodes"] if res is not None else None
+                    )
+                    admit_new(now)
+                    state, n_adm = flush_adm(state)
+                    if not active:
+                        break  # nothing running; next session continues
+                    # ---- dispatch one segment and block on it
+                    live_n = len(active)
+                    helper_n = sum(len(j.helpers) for j in active)
+                    disp_steps = seg
+                    t0 = time.monotonic()
+                    state, tt, n, _summ = dispatch(state, tt, seg)
+                    n = int(stats.fetch(n, "steps"))
+                    wall = time.monotonic() - t0
+                    q_len = q_len_locked()
+                    # ---- process finished lanes at the boundary
+                    lane_done = stats.fetch(
+                        state.lane[:, search_ops.LN_MODE]
+                        == search_ops.MODE_DONE,
+                        "done",
+                    )
+                    res = {
+                        k: stats.fetch(v, k)
+                        for k, v in search_ops.extract_results(
+                            state, 0
+                        ).items()
+                        if k != "steps"
+                    }
+                    now = time.monotonic()
+                    # helper lanes that parked on their own: charge+free
+                    for lane in range(B):
+                        job = lane_owner[lane]
+                        if job is not None and lane_done[lane]:
+                            hn = int(res["nodes"][lane])
+                            job.nodes_total += hn
+                            job.remaining -= hn
+                            del job.helpers[lane]
+                            lane_owner[lane] = None
+                    # primary lanes that parked: aspiration verdict
+                    for lane in range(B):
+                        job = lane_job[lane]
+                        if job is None or not lane_done[lane]:
                             continue
-                        admit_primary(job, free.pop(0))
-                        active.append(job)
-                # ---- spend leftover free lanes on Lazy-SMP helpers
-                if K > 1 and tt is not None and free and active:
-                    n_act = len(active)
-                    cur = sum(len(j.helpers) for j in active)
-                    hardness = [
-                        j.hardness if j.remaining > 0 else 0
-                        for j in active
-                    ]
-                    plan = TpuEngine._plan_helpers(
-                        n_act, n_act + cur + len(free), K, hardness
+                        on_primary_done(job, lane, res, now)
+                    snap = stats.boundary()
+                    self._record_occupancy(
+                        B, n, live_n, helper_n, n_adm, q_len, wall,
+                        snap["host_ms"], snap["device_ms"],
+                        snap["transfers"],
                     )
-                    want: dict = {}
-                    for r, _h in plan:
-                        want[r] = want.get(r, 0) + 1
-                    for r, job in enumerate(active):
-                        while free and len(job.helpers) < want.get(r, 0):
-                            admit_helper(
-                                job, free.pop(0), len(job.helpers) + 1
-                            )
-                # ---- flush this boundary's admissions in ONE splice
-                n_adm = len(adm["lane"])
-                if n_adm:
-                    state = search_ops.refill_lanes(
-                        eng.params, state, stack_boards(adm["board"]),
-                        adm["lane"],
-                        np.asarray(adm["depth"], np.int32),
-                        np.asarray(adm["budget"], np.int32),
-                        variant=variant,
-                        hist_hash=np.stack(adm["hh"]),
-                        hist_halfmove=np.stack(adm["hm"]),
-                        root_alpha=np.asarray(adm["alpha"], np.int32),
-                        root_beta=np.asarray(adm["beta"], np.int32),
-                        order_jitter=np.asarray(adm["jitter"], np.int32),
-                        group=np.asarray(adm["group"], np.int32),
-                    )
-                    for k in adm:
-                        adm[k].clear()
-                if not active:
-                    break  # nothing running; next session handles the rest
-                # ---- dispatch one segment
-                live_n = len(active)
-                helper_n = sum(len(j.helpers) for j in active)
-                t0 = time.monotonic()
-                state, tt, n = search_ops._run_segment_jit(
-                    eng.params, state, tt, seg, variant, False,
-                    prefer_deep, jnp.asarray(gen),
-                )
-                n = int(n)
-                with self._q_lock:
-                    q_len = len(self._pending)
-                self._record_occupancy(
-                    B, n, live_n, helper_n, n_adm, q_len,
-                    time.monotonic() - t0,
-                )
-                # ---- process finished lanes at the boundary
-                lane_done = np.asarray(
-                    state.lane[:, search_ops.LN_MODE] == search_ops.MODE_DONE
-                )
-                res = {
-                    k: np.asarray(v)
-                    for k, v in search_ops.extract_results(state, 0).items()
-                    if k != "steps"
-                }
+                    if ctrl is not None:
+                        seg = ctrl.update(
+                            n >= disp_steps, snap["host_ms"],
+                            snap["device_ms"],
+                        )
+            else:
+                # pipelined double-buffered loop: one segment always in
+                # flight; the boundary is processed from its packed
+                # summary (one small transfer), and when every boundary
+                # decision is already settled the NEXT segment is
+                # dispatched speculatively before blocking, so all the
+                # host bookkeeping below overlaps device compute
                 now = time.monotonic()
-                # helper lanes that parked on their own: charge + free
-                for lane in range(B):
-                    job = lane_owner[lane]
-                    if job is not None and lane_done[lane]:
-                        hn = int(res["nodes"][lane])
-                        job.nodes_total += hn
-                        job.remaining -= hn
-                        del job.helpers[lane]
-                        lane_owner[lane] = None
-                # primary lanes that parked: aspiration verdict
-                for lane in range(B):
-                    job = lane_job[lane]
-                    if job is None or not lane_done[lane]:
+                reap_jobs(now, None)
+                admit_new(now)
+                state, n_adm = flush_adm(state)
+                pend = None
+                if active:
+                    pend_meta = (
+                        len(active),
+                        sum(len(j.helpers) for j in active),
+                        n_adm, q_len_locked(),
+                    )
+                    pend_steps = seg
+                    pend = dispatch(state, tt, seg)
+                    tt = pend[1]
+                while pend is not None:
+                    p_state, p_tt, _pn, p_summ = pend
+                    nxt = None
+                    now = time.monotonic()
+                    margin = now + 2.0 * last_device_s
+                    if (not adm["lane"] and not pv_pending
+                            and q_len_locked() == 0
+                            and all(margin < j.deadline for j in active)):
+                        # no admissions staged, no PV owed, nothing
+                        # queued, no deadline within ~2 segments: the
+                        # synchronous loop would redispatch unchanged
+                        # after this boundary, so issue segment k+1 now
+                        # (donating the in-flight outputs in place)
+                        nxt_meta = (
+                            len(active),
+                            sum(len(j.helpers) for j in active), 0, 0,
+                        )
+                        nxt_steps = seg
+                        nxt = dispatch(p_state, p_tt, seg)
+                        tt = nxt[1]
+                    summ = stats.fetch(p_summ, "summary")
+                    n = int(summ[B, search_ops.SUM_DONE])
+                    lane_done = summ[:B, search_ops.SUM_DONE].astype(bool)
+                    nodes_row = summ[:B, search_ops.SUM_NODES]
+                    # lanes whose park was already handled at an earlier
+                    # speculative boundary (admission staged, splice
+                    # still pending) report DONE again — skip them
+                    staged = set(adm["lane"])
+                    now = time.monotonic()
+                    # helper lanes that parked on their own: charge+free
+                    for lane in range(B):
+                        job = lane_owner[lane]
+                        if (job is not None and lane_done[lane]
+                                and lane not in staged):
+                            hn = int(nodes_row[lane])
+                            job.nodes_total += hn
+                            job.remaining -= hn
+                            del job.helpers[lane]
+                            lane_owner[lane] = None
+                    # primary lanes that parked: aspiration verdict
+                    for lane in range(B):
+                        job = lane_job[lane]
+                        if (job is None or not lane_done[lane]
+                                or lane in staged):
+                            continue
+                        on_primary_parked(
+                            job, lane,
+                            int(summ[lane, search_ops.SUM_SCORE]),
+                            int(summ[lane, search_ops.SUM_MOVE]),
+                            int(nodes_row[lane]), nodes_row, now,
+                        )
+                    reap_jobs(now, nodes_row)
+                    admit_new(now)
+                    if nxt is None:
+                        # PV pulls read the resolved p_state BEFORE the
+                        # refill splice below resets those lanes
+                        flush_pv(p_state, now)
+                    snap = stats.boundary()
+                    last_device_s = snap["device_ms"] / 1000.0
+                    self._record_occupancy(
+                        B, n, pend_meta[0], pend_meta[1], pend_meta[2],
+                        pend_meta[3],
+                        (snap["host_ms"] + snap["device_ms"]) / 1000.0,
+                        snap["host_ms"], snap["device_ms"],
+                        snap["transfers"],
+                    )
+                    if ctrl is not None:
+                        seg = ctrl.update(
+                            n >= pend_steps, snap["host_ms"],
+                            snap["device_ms"],
+                        )
+                    if nxt is not None:
+                        pend = nxt
+                        pend_meta = nxt_meta
+                        pend_steps = nxt_steps
                         continue
-                    on_primary_done(job, lane, res, now)
+                    state, n_adm = flush_adm(p_state)
+                    if not active:
+                        break  # next session handles the rest
+                    pend_meta = (
+                        len(active),
+                        sum(len(j.helpers) for j in active),
+                        n_adm, q_len_locked(),
+                    )
+                    pend_steps = seg
+                    pend = dispatch(state, tt, seg)
+                    tt = pend[1]
         except BaseException as e:
             # the driver died mid-session (device fault, OOM...): fail
             # every admitted job so no submitting thread waits forever
@@ -1779,12 +2037,20 @@ class LaneScheduler:
             for job in active:
                 release(job, None)
                 self._finalize(job, now, error=f"tpu engine failed: {e}")
+            # jobs released at a park boundary whose _finalize was still
+            # deferred behind a PV pull: complete them with what the
+            # summary recorded, or their submitters wait forever
+            for job, _lane, _depth, final in pv_pending:
+                if final:
+                    self._finalize(job, now)
+            pv_pending.clear()
             raise
         finally:
             eng.tt = tt
 
     def _record_occupancy(self, width, steps, live, helpers, refilled,
-                          queue, wall):
+                          queue, wall, host_ms=0.0, device_ms=0.0,
+                          transfers=0):
         eng = self.engine
         tot = eng.occupancy_totals
         idle = width - live - helpers
@@ -1795,10 +2061,15 @@ class LaneScheduler:
         tot["helper_lane_steps"] += steps * helpers
         tot["idle_lane_steps"] += steps * idle
         tot["refills"] += refilled
+        tot["host_ms"] += host_ms
+        tot["device_ms"] += device_ms
+        tot["transfers"] += transfers
         eng.occupancy_log.append({
             "segment": tot["segments"], "width": width, "steps": steps,
             "live": live, "helpers": helpers, "idle": idle,
             "refilled": refilled, "queue": queue,
+            "transfers": transfers, "host_ms": host_ms,
+            "device_ms": device_ms,
         })
         if len(eng.occupancy_log) > 4096:
             del eng.occupancy_log[:-4096]
@@ -1806,5 +2077,7 @@ class LaneScheduler:
             eng.trace(
                 f"refill seg={tot['segments']} steps={steps} "
                 f"live={live}/{width} helpers={helpers} idle={idle} "
-                f"refilled={refilled} queue={queue} wall={wall:.3f}s"
+                f"refilled={refilled} queue={queue} wall={wall:.3f}s "
+                f"host={host_ms:.1f}ms dev={device_ms:.1f}ms "
+                f"xfers={transfers}"
             )

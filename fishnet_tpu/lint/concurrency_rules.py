@@ -14,6 +14,11 @@ that true:
   conc-silent-except   a broad handler (Exception/BaseException/bare)
                        whose body neither logs nor raises — failures
                        vanish without a trace
+  conc-host-sync       a blocking host sync (int(), np.asarray(),
+                       .block_until_ready(), jax.device_get) applied to
+                       a device-resident value inside the scheduler
+                       loop — every such sync stalls the pipeline and
+                       escapes the SyncStats transfer accounting
 
 Scopes: the timeout/lock rules run on the process-boundary modules
 (supervisor, host, uci, workers, queue); the except rules run on all of
@@ -21,6 +26,16 @@ client/ and engine/ (kernels and utils keep their own idioms — e.g.
 compile_cache deliberately degrades to "no cache" on any error).
 Narrow handlers (`except OSError: pass` around best-effort logging) are
 deliberately not flagged — the rules target *broad* swallowing.
+
+The host-sync rule runs on the LaneScheduler module only: values that
+flow from the segment dispatch jits (`_run_segment_jit`,
+`_init_state_jit`, `_merge_lanes_jit`, `refill_lanes`,
+`extract_results`, or a local `dispatch`/`flush_adm` wrapper) are
+device-resident, and the only sanctioned way to materialize one on the
+host inside a `while` loop is `SyncStats.fetch`, which counts the
+transfer and measures the blocked time (utils/syncstats.py).
+`stats.fetch(x)` is naturally absolved — the rule tracks the names, and
+a fetch result is a host value, not a device one.
 """
 from __future__ import annotations
 
@@ -45,6 +60,15 @@ BLOCK_SCOPE = (
 
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine")
+
+# the scheduler loop: blocking host syncs here stall the segment pipeline
+HOST_SYNC_SCOPE = ("fishnet_tpu/engine/tpu.py",)
+
+# calls whose results are device arrays (or tuples of them); a local
+# `dispatch`/`flush_adm` closure wrapping the segment jit counts too
+_DEVICE_PRODUCERS = ("_run_segment_jit", "_init_state_jit",
+                     "_merge_lanes_jit", "refill_lanes", "extract_results",
+                     "dispatch", "flush_adm")
 
 # attribute calls that block the caller until a peer acts
 _WAITING_ATTRS = ("join", "get", "wait", "recv")
@@ -119,9 +143,98 @@ def _body_trivial(body: List[ast.stmt]) -> bool:
     return True
 
 
+def _assign_targets(node: ast.Assign) -> List[str]:
+    out: List[str] = []
+    for t in node.targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+    return out
+
+
+def _sync_sink(call: ast.Call, device: set) -> str:
+    """Name of the device-resident value this call blocks on, or ''."""
+    target = dotted(call.func)
+    tail = target.split(".")[-1]
+    arg = call.args[0] if call.args else None
+    if target == "int" or tail in ("asarray", "device_get",
+                                   "block_until_ready"):
+        if isinstance(arg, ast.Name) and arg.id in device:
+            return arg.id
+    # method form: state.block_until_ready()
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr == "block_until_ready" and \
+            isinstance(call.func.value, ast.Name) and \
+            call.func.value.id in device:
+        return call.func.value.id
+    return ""
+
+
+def _check_host_sync(src, findings: List[Finding]) -> None:
+    """Forward flow per function: names fed from the segment-dispatch
+    jits are device-resident until rebound; materializing one inside a
+    `while` loop other than via SyncStats.fetch is a finding."""
+    parents = _parents(src.tree)
+
+    def in_while(node: ast.AST) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.While):
+                return True
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            cur = parents.get(cur)
+        return False
+
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        device: set = set()
+        stmts = sorted(
+            (n for n in ast.walk(fn)
+             if isinstance(n, (ast.Assign, ast.Expr, ast.AugAssign))),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for stmt in stmts:
+            # sinks first: the RHS evaluates before the rebind
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) and in_while(node):
+                    name = _sync_sink(node, device)
+                    if name:
+                        findings.append(src.finding(
+                            "conc-host-sync", node,
+                            f"blocking host sync on device value "
+                            f"'{name}' inside the scheduler loop; route "
+                            "it through SyncStats.fetch so the transfer "
+                            "is counted and the blocked time measured",
+                        ))
+            if not isinstance(stmt, ast.Assign):
+                continue
+            val = stmt.value
+            is_device = False
+            if isinstance(val, ast.Call):
+                tail = dotted(val.func).split(".")[-1]
+                is_device = tail in _DEVICE_PRODUCERS
+            elif isinstance(val, ast.Name):
+                is_device = val.id in device
+            elif isinstance(val, ast.Subscript) and \
+                    isinstance(val.value, ast.Name):
+                # tt = pend[1]: slicing a device tuple stays on device
+                is_device = val.value.id in device
+            for name in _assign_targets(stmt):
+                if is_device:
+                    device.add(name)
+                else:
+                    device.discard(name)
+
+
 @register_family("concurrency")
 def check_concurrency(project: Project) -> List[Finding]:
     findings: List[Finding] = []
+
+    for src in project.in_dirs(*HOST_SYNC_SCOPE):
+        _check_host_sync(src, findings)
 
     for src in project.in_dirs(*BLOCK_SCOPE):
         parents = _parents(src.tree)

@@ -38,11 +38,21 @@ lanes are cheap, so multipv lanes are just more lanes.
 """
 from __future__ import annotations
 
+import warnings
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Buffer donation below is best-effort by design: XLA:CPU declines to
+# alias through the select ops _merge_lanes lowers to, and jax then
+# warns once per compile. The donation still holds wherever the backend
+# CAN alias (the big _run_segment tables, TPU merges), so the warning is
+# pure noise here — silence exactly it, nothing broader.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 from ..models import nnue
 from ..utils import settings
@@ -67,6 +77,14 @@ MODE_ENTER = 0
 MODE_RETURN = 1
 MODE_TRYMOVE = 2
 MODE_DONE = 3
+
+# packed boundary summary (int32, shape (B+1, 4)): everything the host
+# needs to decide a segment boundary — done bitmap plus per-lane
+# nodes/score/best-move — in ONE small transfer instead of the full
+# extract_results set; row B broadcasts the segment's step count. PV
+# rows are pulled separately, and only for lanes that actually finished.
+SUM_DONE, SUM_NODES, SUM_SCORE, SUM_MOVE = range(4)
+SUM_W = 4
 
 # game-history repetition seeding: hashes of up to MAX_HIST reversible
 # game positions before each lane's root (the reference feeds Stockfish
@@ -966,14 +984,36 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
     state, ttab, n = jax.lax.while_loop(
         cond, body, (state, ttab, jnp.int32(0))
     )
-    return state, ttab, n
+    lane = state.lane
+    summary = jnp.concatenate([
+        jnp.stack([
+            (lane[:, LN_MODE] == MODE_DONE).astype(jnp.int32),
+            lane[:, LN_NODES],
+            lane[:, LN_RSCORE],
+            lane[:, LN_RMOVE],
+        ], axis=1),
+        jnp.full((1, SUM_W), n, jnp.int32),
+    ], axis=0)
+    return state, ttab, n, summary
 
 
+# segment_steps is TRACED (an int32 operand of the while cond), not
+# static: the FISHNET_TPU_SEGMENT=auto controller retunes the length
+# between segments with zero recompiles. state and ttab are DONATED —
+# chained segments alias the multi-MB tables in place instead of
+# copying them, so a caller must treat the arguments it passed as
+# consumed and continue from the returned state/ttab only.
 _run_segment_jit = jax.jit(
     _run_segment,
-    static_argnames=("segment_steps", "variant", "deep_tt", "prefer_deep"),
+    static_argnames=("variant", "deep_tt", "prefer_deep"),
+    donate_argnums=(1, 2),
 )
-_init_state_jit = jax.jit(init_state, static_argnames=("max_ply", "variant"))
+# the big tables are OUTPUTS of init_state; its only device-state-shaped
+# inputs are the history rows, donated so refill splices don't copy them
+_init_state_jit = jax.jit(
+    init_state, static_argnames=("max_ply", "variant"),
+    donate_argnames=("hist_hash", "hist_halfmove"),
+)
 
 
 def extract_results(state: SearchState, steps) -> dict:
@@ -1018,7 +1058,10 @@ def _merge_lanes(state: SearchState, fresh: SearchState,
     return jax.tree.map(pick, state, fresh)
 
 
-_merge_lanes_jit = jax.jit(_merge_lanes)
+# both inputs are donated: the running state's tables are overwritten in
+# place where the mask selects, and the fresh (refill-sized) state is
+# consumed by the splice — a refill boundary allocates nothing big
+_merge_lanes_jit = jax.jit(_merge_lanes, donate_argnums=(0, 1))
 
 
 def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
@@ -1053,10 +1096,13 @@ def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
 
     def expand(x, fill, dtype, tail=()):
         if x is None:
-            arr = np.full((n,) + tail, fill, dtype)
-        else:
-            arr = np.asarray(x)
-        return jnp.asarray(arr)[tk]
+            x = np.full((n,) + tail, fill, dtype)
+        elif isinstance(x, jax.Array):
+            # already device-resident (e.g. carried from a previous
+            # segment's outputs): gather on device — np.asarray here
+            # would block the host and round-trip the rows through it
+            return jnp.take(x, tk, axis=0)
+        return jnp.asarray(np.asarray(x))[tk]
 
     roots_full = jax.tree.map(lambda a: jnp.asarray(a)[tk], new_roots)
     fresh = _init_state_jit(
@@ -1090,6 +1136,8 @@ def search_stream(
     hist=None,
     prefer_deep_store: bool = False,
     tt_gen_start: int = 1,
+    pipeline: bool | None = None,
+    sync_stats=None,
 ):
     """Stream N root positions through a fixed `width`-lane program.
 
@@ -1102,18 +1150,46 @@ def search_stream(
     aspiration windows and per-position deadlines on top of the same
     primitives.
 
+    pipeline (default FISHNET_TPU_PIPELINE): asynchronous segment
+    boundaries — the host fetches ONE packed summary per boundary
+    instead of the full result set, pulls PV rows only for lanes that
+    actually finished, and, when the refill queue is empty (no boundary
+    decision pending), dispatches the next segment speculatively before
+    blocking on the current one, so host bookkeeping overlaps device
+    compute. False restores the round-7 synchronous loop; results are
+    bit-identical in both modes. sync_stats: optional
+    utils.syncstats.SyncStats to account transfers into.
+
+    segment_steps None reads FISHNET_TPU_SEGMENT; "auto" runs the
+    measured-feedback SegmentController within the registry bounds.
+
     Returns per-position (N,) results keyed as extract_results, plus:
       occupancy: list of per-segment dicts {segment, steps, live, idle,
-                 refilled, queue} — live counts lanes still searching at
-                 the boundary, refilled the lanes spliced this boundary,
-                 idle = width - live - refilled.
+                 refilled, queue, transfers, elements, host_ms,
+                 device_ms} — live counts lanes still searching at the
+                 boundary, refilled the lanes spliced this boundary,
+                 idle = width - live - refilled; the last four come from
+                 utils.syncstats (transfer count and the host/device
+                 wall-clock split of the boundary interval).
       refills:   total refill events (lanes spliced) across the run.
     Positions not finished by deadline/max_steps report done=False.
     """
     import time as _time
 
+    from ..utils.syncstats import SegmentController, SyncStats
+
+    if pipeline is None:
+        pipeline = settings.get_bool("FISHNET_TPU_PIPELINE")
+    stats = sync_stats if sync_stats is not None else SyncStats()
+    ctrl = None
     if segment_steps is None:
-        segment_steps = settings.get_int("FISHNET_TPU_SEGMENT")
+        segment_steps = settings.get_segment()
+        if segment_steps is None:  # FISHNET_TPU_SEGMENT=auto
+            ctrl = SegmentController(
+                settings.get_int("FISHNET_TPU_SEGMENT_MIN"),
+                settings.get_int("FISHNET_TPU_SEGMENT_MAX"),
+            )
+            segment_steps = ctrl.steps
     N = int(roots.stm.shape[0])
     P = max_ply
     depth = np.broadcast_to(np.asarray(depth, np.int32), (N,)).copy()
@@ -1180,49 +1256,162 @@ def search_stream(
     refills_total = 0
     total = 0
     seg_i = 0
-    while total < max_steps:
-        if deadline is not None and _time.monotonic() >= deadline:
-            break
-        state, tt, n = _run_segment_jit(
-            params, state, tt, segment_steps, variant, False,
+
+    def dispatch(st, table, seg_n):
+        return _run_segment_jit(
+            params, st, table, seg_n, variant, False,
             prefer_deep_store, jnp.asarray(gen),
         )
-        total += int(n)
+
+    def do_refill(st, free, n_ref):
+        nonlocal next_gen, refills_total
+        take_pos = np.asarray(queue[:n_ref], np.int64)
+        del queue[:n_ref]
+        sel = free[:n_ref]
+        lane_pos[sel] = take_pos
+        gen[sel] = (
+            np.arange(next_gen, next_gen + n_ref) & 0x3FFFFFFF
+        ).astype(np.int32)
+        next_gen += n_ref
+        hh, hm = hist_rows(take_pos)
+        refills_total += n_ref
+        return refill_lanes(
+            params, st, gather_roots(take_pos), sel,
+            depth[take_pos], node_budget[take_pos], variant=variant,
+            hist_hash=hh, hist_halfmove=hm,
+        )
+
+    def pull_pv(st, lanes, pos):
+        """Materialize PV rows for finished lanes only: two small
+        device-side gathers instead of the full (B, P) table."""
+        rows = jnp.asarray(np.asarray(lanes, np.int64))
+        out["pv"][pos] = stats.fetch(
+            jnp.take(st.pv[:, 0], rows, axis=0), "pv")
+        out["pv_len"][pos] = stats.fetch(
+            jnp.take(st.nt[:, 0, NT_PVLEN], rows, axis=0), "pv_len")
+
+    def record(n, live, n_ref, pend_steps):
+        nonlocal seg_i, segment_steps
         seg_i += 1
-        lane_done = np.asarray(state.lane[:, LN_MODE] == MODE_DONE)
-        res = extract_results(state, jnp.int32(total))
-        fin = np.nonzero(lane_done & (lane_pos >= 0))[0]
-        if fin.size:
-            for key in out:
-                out[key][lane_pos[fin]] = np.asarray(res[key])[fin]
-            done_out[lane_pos[fin]] = True
-            lane_pos[fin] = -1
-        live = int((lane_pos >= 0).sum())
-        free = np.nonzero(lane_pos < 0)[0]
-        n_ref = min(len(free), len(queue))
-        if n_ref and (deadline is None or _time.monotonic() < deadline):
-            take_pos = np.asarray(queue[:n_ref], np.int64)
-            del queue[:n_ref]
-            sel = free[:n_ref]
-            lane_pos[sel] = take_pos
-            gen[sel] = (
-                np.arange(next_gen, next_gen + n_ref) & 0x3FFFFFFF
-            ).astype(np.int32)
-            next_gen += n_ref
-            hh, hm = hist_rows(take_pos)
-            state = refill_lanes(
-                params, state, gather_roots(take_pos), sel,
-                depth[take_pos], node_budget[take_pos], variant=variant,
-                hist_hash=hh, hist_halfmove=hm,
-            )
-            refills_total += n_ref
+        snap = stats.boundary()
         occupancy.append({
             "segment": seg_i, "steps": int(n), "live": live,
             "refilled": int(n_ref),
             "idle": width - live - int(n_ref), "queue": len(queue),
+            **snap,
         })
-        if live == 0 and n_ref == 0 and not queue:
-            break
+        if ctrl is not None:
+            segment_steps = ctrl.update(
+                int(n) >= pend_steps, snap["host_ms"], snap["device_ms"])
+
+    final_state, final_tt = state, tt
+    if not pipeline:
+        # round-7 synchronous loop: block on the segment, materialize
+        # the full result set, refill, repeat (kept bit-for-bit for
+        # FISHNET_TPU_PIPELINE=0 and as the A/B baseline)
+        while total < max_steps:
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            state, tt, n, _summ = dispatch(state, tt, segment_steps)
+            pend_steps = segment_steps
+            n = int(stats.fetch(n, "steps"))
+            total += n
+            lane_done = stats.fetch(
+                state.lane[:, LN_MODE] == MODE_DONE, "done")
+            res = extract_results(state, jnp.int32(total))
+            fin = np.nonzero(lane_done & (lane_pos >= 0))[0]
+            if fin.size:
+                for key in out:
+                    out[key][lane_pos[fin]] = stats.fetch(res[key], key)[fin]
+                done_out[lane_pos[fin]] = True
+                lane_pos[fin] = -1
+            live = int((lane_pos >= 0).sum())
+            free = np.nonzero(lane_pos < 0)[0]
+            n_ref = min(len(free), len(queue))
+            if n_ref and (deadline is None or _time.monotonic() < deadline):
+                state = do_refill(state, free, n_ref)
+            record(n, live, n_ref, pend_steps)
+            if live == 0 and n_ref == 0 and not queue:
+                break
+        final_state, final_tt = state, tt
+    else:
+        # pipelined loop: one in-flight segment at all times; while it
+        # runs, the host processes the PREVIOUS boundary from its packed
+        # summary, and when no refill decision is pending the NEXT
+        # segment is dispatched speculatively (chained on the in-flight
+        # segment's output futures) before blocking on the summary
+        pend = None
+        pend_steps = segment_steps
+        prev_live = k > 0
+        pv_pending: list[tuple[int, int]] = []  # deferred (lane, pos)
+        if total < max_steps and (
+                deadline is None or _time.monotonic() < deadline):
+            pend = dispatch(state, tt, segment_steps)
+        while pend is not None:
+            p_state, p_tt, _p_n, p_summ = pend
+            nxt = None
+            nxt_steps = segment_steps
+            if (prev_live and not queue
+                    and total + pend_steps < max_steps
+                    and (deadline is None or _time.monotonic() < deadline)):
+                # the queue is empty, so the synchronous loop would
+                # dispatch this exact segment after the boundary anyway;
+                # issuing it now donates p_state/p_tt in place and keeps
+                # the device busy across the host's boundary work
+                nxt = dispatch(p_state, p_tt, nxt_steps)
+            summ = stats.fetch(p_summ, "summary")
+            n = int(summ[width, SUM_DONE])
+            total += n
+            lane_done = summ[:width, SUM_DONE].astype(bool)
+            fin = np.nonzero(lane_done & (lane_pos >= 0))[0]
+            if fin.size:
+                pos = lane_pos[fin]
+                out["score"][pos] = summ[fin, SUM_SCORE]
+                out["move"][pos] = summ[fin, SUM_MOVE]
+                out["nodes"][pos] = summ[fin, SUM_NODES]
+                done_out[pos] = True
+                if nxt is None:
+                    pull_pv(p_state, fin, pos)
+                else:
+                    # p_state was donated into the speculative dispatch;
+                    # DONE lanes stay frozen (and the empty queue means
+                    # they are never respliced), so their PV rows are
+                    # pulled from a later resolved state
+                    pv_pending.extend(zip(fin.tolist(), pos.tolist()))
+                lane_pos[fin] = -1
+            if pv_pending and nxt is None:
+                lanes = np.asarray([ln for ln, _ in pv_pending], np.int64)
+                pos = np.asarray([p for _, p in pv_pending], np.int64)
+                pull_pv(p_state, lanes, pos)
+                pv_pending.clear()
+            live = int((lane_pos >= 0).sum())
+            free = np.nonzero(lane_pos < 0)[0]
+            n_ref = min(len(free), len(queue))
+            cur_state = p_state
+            if (n_ref and nxt is None
+                    and (deadline is None or _time.monotonic() < deadline)):
+                cur_state = do_refill(cur_state, free, n_ref)
+            else:
+                n_ref = 0
+            record(n, live, n_ref, pend_steps)
+            if nxt is not None:
+                pend = nxt
+                pend_steps = nxt_steps
+                prev_live = live > 0
+                continue
+            stop = (
+                (live == 0 and n_ref == 0 and not queue)
+                or total >= max_steps
+                or (deadline is not None
+                    and _time.monotonic() >= deadline)
+            )
+            if stop:
+                final_state, final_tt = cur_state, p_tt
+                pend = None
+            else:
+                pend = dispatch(cur_state, p_tt, segment_steps)
+                pend_steps = segment_steps
+                prev_live = live > 0 or n_ref > 0
 
     return {
         "score": jnp.asarray(out["score"]),
@@ -1234,7 +1423,7 @@ def search_stream(
         "steps": jnp.int32(total),
         "occupancy": occupancy,
         "refills": refills_total,
-        "tt": tt,
+        "tt": final_tt,
     }
 
 
@@ -1311,9 +1500,14 @@ def search_batch_resumable(
 
     # segment length and narrowing floor are registry-backed so deployments
     # can trade host-check latency against dispatch overhead without code
-    # edits; the defaults reproduce the historical hardcoded values exactly
+    # edits; the defaults reproduce the historical hardcoded values exactly.
+    # FISHNET_TPU_SEGMENT=auto has no feedback loop on this path (the
+    # controller lives in the streaming loops) — it falls back to the
+    # registry's upper bound
     if segment_steps is None:
-        segment_steps = settings.get_int("FISHNET_TPU_SEGMENT")
+        segment_steps = settings.get_segment()
+        if segment_steps is None:
+            segment_steps = settings.get_int("FISHNET_TPU_SEGMENT_MAX")
     narrow_floor = settings.get_int("FISHNET_TPU_NARROW_FLOOR")
 
     B = roots.stm.shape[0]
@@ -1341,7 +1535,7 @@ def search_batch_resumable(
             return state, tt, int(np.max(np.asarray(n)))
     else:
         def dispatch(state, tt):
-            state, tt, n = _run_segment_jit(
+            state, tt, n, _summ = _run_segment_jit(
                 params, state, tt, segment_steps, variant, deep_tt,
                 prefer_deep_store, jnp.int32(tt_gen),
             )
@@ -1438,9 +1632,12 @@ def search_batch(params: nnue.NnueParams, roots: Board, depth, node_budget,
     tests and production share the same `_run_segment_jit` programs; a
     second whole-search jit used to double every suite's compile cost).
     """
+    seg = settings.get_segment()
+    if seg is None:
+        seg = settings.get_int("FISHNET_TPU_SEGMENT_MAX")
     return search_batch_resumable(
         params, roots, depth, node_budget, max_ply=max_ply,
-        segment_steps=min(max_steps, settings.get_int("FISHNET_TPU_SEGMENT")),
+        segment_steps=min(max_steps, seg),
         max_steps=max_steps, tt=tt, variant=variant, hist=hist,
     )
 

@@ -69,7 +69,7 @@ def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
     def seg(params, state, ttab, tt_gen):
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[0], ttab)  # (1, N) block → (N,)
-        state, ttab, n = _run_segment(
+        state, ttab, n, _summ = _run_segment(
             params, state, ttab, segment_steps, variant, deep_tt,
             prefer_deep, tt_gen,
         )

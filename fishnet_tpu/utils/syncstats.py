@@ -1,0 +1,139 @@
+"""Host-device synchronization instrumentation for the segment pipeline.
+
+Every host-blocking materialization of a device value in the streaming
+loops (ops/search.py search_stream, engine/tpu.py LaneScheduler) routes
+through ONE choke point — SyncStats.fetch — so the per-boundary cost the
+round-5 profile flagged (~290 us/step fixed gap, amplified by the
+round-7 scheduler's full-result fetch at every boundary) is *measured*,
+not guessed: how many transfers, how many elements, and how long the
+host sat blocked on the device per segment.
+
+The split reported per segment:
+
+  device_ms  wall-clock the host spent BLOCKED inside fetch() — with a
+             single summary fetch per boundary this approximates the
+             device's segment compute time;
+  host_ms    everything else in the boundary interval — scheduling,
+             refill staging, result bookkeeping: the part the pipeline
+             overlaps with the next segment's device compute.
+
+fishnet-lint's conc-host-sync rule (lint/concurrency_rules.py) flags
+raw int()/np.asarray()/block_until_ready() on jit outputs inside the
+scheduler's segment loop; routing through fetch() is the sanctioned
+form precisely because it keeps these counters honest.
+
+Keep this module free of JAX imports at module scope — like settings.py
+it is imported by conftest and the linter before JAX initializes; numpy
+only (np.asarray blocks on jax.Array inputs without importing jax).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class SyncStats:
+    """Per-segment transfer and blocked-time accounting.
+
+    One instance per streaming run (or one long-lived instance per
+    engine); boundary() closes the current segment's accounting window
+    and returns its snapshot dict.
+    """
+
+    def __init__(self) -> None:
+        self.transfers_total = 0
+        self.elements_total = 0
+        self.blocked_ms_total = 0.0
+        self.segments_total = 0
+        self._seg_transfers = 0
+        self._seg_elements = 0
+        self._seg_blocked_ms = 0.0
+        self._seg_start = time.monotonic()
+
+    # ------------------------------------------------------------ fetch
+
+    def fetch(self, value, label: str = "") -> np.ndarray:
+        """Materialize a device value on the host, counting one transfer
+        and the wall-clock spent blocked. The single sanctioned host-sync
+        site for the segment loops (lint rule conc-host-sync)."""
+        t0 = time.monotonic()
+        arr = np.asarray(value)
+        dt_ms = (time.monotonic() - t0) * 1000.0
+        self._seg_transfers += 1
+        self._seg_elements += int(arr.size)
+        self._seg_blocked_ms += dt_ms
+        self.transfers_total += 1
+        self.elements_total += int(arr.size)
+        self.blocked_ms_total += dt_ms
+        return arr
+
+    # --------------------------------------------------------- boundary
+
+    def boundary(self) -> dict:
+        """Close the current segment's accounting window.
+
+        Returns {"transfers", "elements", "device_ms", "host_ms"} for
+        the interval since the previous boundary() (or construction):
+        device_ms is the blocked-in-fetch time, host_ms the remainder of
+        the interval's wall-clock.
+        """
+        now = time.monotonic()
+        wall_ms = (now - self._seg_start) * 1000.0
+        snap = {
+            "transfers": self._seg_transfers,
+            "elements": self._seg_elements,
+            "device_ms": round(self._seg_blocked_ms, 3),
+            "host_ms": round(max(wall_ms - self._seg_blocked_ms, 0.0), 3),
+        }
+        self.segments_total += 1
+        self._seg_transfers = 0
+        self._seg_elements = 0
+        self._seg_blocked_ms = 0.0
+        self._seg_start = now
+        return snap
+
+
+class SegmentController:
+    """Measured-feedback segment-length tuner (FISHNET_TPU_SEGMENT=auto).
+
+    Holds the boundary-cost share — host_ms / (host_ms + device_ms) per
+    segment — inside a hysteresis band by doubling the segment length
+    when boundaries dominate and halving it when the host is already
+    negligible (shorter segments mean lower deadline/refill latency, so
+    the controller never pays for responsiveness it doesn't need).
+    Bounds come from the settings registry (FISHNET_TPU_SEGMENT_MIN /
+    _MAX); adjustments are power-of-two so the step count revisits the
+    same few values instead of drifting. segment_steps is a *traced*
+    argument of _run_segment_jit, so retuning never recompiles.
+    """
+
+    def __init__(self, lo: int, hi: int, start: Optional[int] = None,
+                 low_share: float = 0.02, high_share: float = 0.10) -> None:
+        if lo < 1:
+            raise ValueError(f"segment lower bound must be >= 1, got {lo}")
+        if hi < lo:
+            raise ValueError(f"segment bounds inverted: [{lo}, {hi}]")
+        self.lo = lo
+        self.hi = hi
+        self.low_share = low_share
+        self.high_share = high_share
+        self.steps = min(max(start if start is not None else lo, lo), hi)
+
+    def update(self, ran_full: bool, host_ms: float,
+               device_ms: float) -> int:
+        """Feed one boundary's measurement; returns the step count for
+        the next segment. Segments that ended early (every lane DONE)
+        carry no length signal and leave the setting untouched."""
+        if not ran_full:
+            return self.steps
+        total = host_ms + device_ms
+        if total <= 0.0:
+            return self.steps
+        share = host_ms / total
+        if share > self.high_share:
+            self.steps = min(self.steps * 2, self.hi)
+        elif share < self.low_share:
+            self.steps = max(self.steps // 2, self.lo)
+        return self.steps

@@ -417,6 +417,85 @@ def f(log):
     assert len(by_rule(result.findings, "conc-silent-except")) == 2
 
 
+SCHED_LOOP_BAD = '''
+import numpy as np
+import jax
+
+
+def drive(params, state, tt, seg, stats):
+    state, tt, n, summ = _run_segment_jit(params, state, tt, seg)
+    while True:
+        state, tt, n, summ = _run_segment_jit(params, state, tt, seg)
+        steps = int(n)                       # conc-host-sync
+        row = np.asarray(summ)               # conc-host-sync
+        state.block_until_ready()            # conc-host-sync
+        host = jax.device_get(summ)          # conc-host-sync
+        if steps == 0:
+            break
+    return state, tt
+'''
+
+
+SCHED_LOOP_CLEAN = '''
+def drive(params, state, tt, seg, stats):
+    while True:
+        state, tt, n, summ = _run_segment_jit(params, state, tt, seg)
+        steps = int(stats.fetch(n, "steps"))   # fetch is the sanctioned sink
+        summ = stats.fetch(summ, "summary")
+        row = int(summ[0])                     # fetched: host value now
+        if steps == 0:
+            break
+    return state, tt
+'''
+
+
+def test_host_sync_in_scheduler_loop_flagged(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/tpu.py": SCHED_LOOP_BAD}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    flagged = by_rule(result.findings, "conc-host-sync")
+    assert [f.line for f in flagged] == [10, 11, 12, 13]
+    # the pre-loop dispatch is not inside the while: never flagged
+    assert all("'n'" in f.message or "'summ'" in f.message or
+               "'state'" in f.message for f in flagged)
+
+
+def test_host_sync_via_fetch_is_clean(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/tpu.py": SCHED_LOOP_CLEAN}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert by_rule(result.findings, "conc-host-sync") == []
+
+
+def test_host_sync_tracks_tuple_unpack_and_subscript(tmp_path):
+    src = '''
+def drive(params, state, tt, seg, stats):
+    pend = dispatch(state, tt, seg)
+    while pend is not None:
+        p_state, p_tt, pn, p_summ = pend
+        tt = pend[1]
+        bad = int(pn)                        # conc-host-sync
+        pend = dispatch(p_state, tt, seg)
+    return tt
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/tpu.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    flagged = by_rule(result.findings, "conc-host-sync")
+    assert len(flagged) == 1 and "'pn'" in flagged[0].message
+
+
+def test_host_sync_scope_is_scheduler_module_only(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/other.py": SCHED_LOOP_BAD}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert by_rule(result.findings, "conc-host-sync") == []
+
+
 # ------------------------------------------- suppressions, baseline, CLI
 
 

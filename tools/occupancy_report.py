@@ -17,6 +17,16 @@ Usage:
 fraction falls below --threshold (default 0.5): sustained low occupancy
 means the refill queue drained long before the stragglers finished, i.e.
 the stage is paying full-width step cost for mostly-idle lanes.
+
+Round 8 (segment pipeline): every row also shows the boundary's host
+transfer count and host/device wall-clock split (utils/syncstats.py via
+search_stream), and the summary line reports the aggregate boundary
+share host_ms/(host_ms+device_ms). --host-share-threshold warns (a
+::warning annotation under --format=github) when that share exceeds the
+bound — the pipeline exists precisely to keep it small. --pipeline-ab
+runs the stage twice (FISHNET_TPU_PIPELINE off, then on) and FAILS on
+any per-position result divergence: the pipelined loop must be
+bit-identical to the round-7 synchronous loop.
 """
 from __future__ import annotations
 
@@ -64,6 +74,12 @@ def main() -> int:
     ap.add_argument("--net", choices=("random", "default"), default="default")
     ap.add_argument("--threshold", type=float, default=0.5,
                     help="annotate when mean live fraction is below this")
+    ap.add_argument("--host-share-threshold", type=float, default=0.25,
+                    help="annotate when the boundary host share "
+                         "host_ms/(host_ms+device_ms) exceeds this")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="run the stage with the segment pipeline off "
+                         "then on; FAIL on any result divergence")
     ap.add_argument("--format", choices=("text", "github"), default="text")
     ap.add_argument("--json", action="store_true",
                     help="print a machine-readable summary line")
@@ -107,43 +123,91 @@ def main() -> int:
                             if args.smoke else None))
     depth = np.full(n, args.depth, np.int32)
     budget = np.full(n, args.budget, np.int32)
-    tt = None
-    if args.tt_log2:
-        from fishnet_tpu.ops import tt as tt_mod
 
-        tt = tt_mod.make_table(args.tt_log2)
+    def run(pipeline=None):
+        # the table (and the running state) are DONATED into the segment
+        # jits, so every pass gets its own fresh table
+        tt = None
+        if args.tt_log2:
+            from fishnet_tpu.ops import tt as tt_mod
 
-    t0 = time.perf_counter()
-    out = S.search_stream(
-        params, roots, depth, budget, max_ply=args.max_ply,
-        width=args.lanes, segment_steps=args.segment, tt=tt,
-    )
-    jax.block_until_ready(out["nodes"])
-    wall = time.perf_counter() - t0
+            tt = tt_mod.make_table(args.tt_log2)
+        t0 = time.perf_counter()
+        out = S.search_stream(
+            params, roots, depth, budget, max_ply=args.max_ply,
+            width=args.lanes, segment_steps=args.segment, tt=tt,
+            pipeline=pipeline,
+        )
+        jax.block_until_ready(out["nodes"])
+        return out, time.perf_counter() - t0
 
-    # ops-level rows: {segment, steps, live, refilled, idle, queue}
+    legacy = None
+    if args.pipeline_ab:
+        legacy = run(pipeline=False)
+        out, wall = run(pipeline=True)
+    else:
+        out, wall = run()
+
+    # ops-level rows: {segment, steps, live, refilled, idle, queue} plus
+    # the round-8 syncstats columns {transfers, host_ms, device_ms}
     # (the engine's LaneScheduler adds helper counts on top of these)
     occ = out["occupancy"]
     lane_steps = sum(o["steps"] * args.lanes for o in occ) or 1
     live_steps = sum(o["steps"] * (o["live"] + o["refilled"]) for o in occ)
     mean_live = live_steps / lane_steps
+    host_ms = sum(o["host_ms"] for o in occ)
+    device_ms = sum(o["device_ms"] for o in occ)
+    boundary_share = host_ms / max(host_ms + device_ms, 1e-9)
+    transfers = sum(o["transfers"] for o in occ)
     done = int(np.asarray(out["done"]).sum())
 
     print(f"{'seg':>4} {'steps':>6} {'live':>5} {'idle':>5} "
-          f"{'refill':>6} {'queue':>5}")
+          f"{'refill':>6} {'queue':>5} {'xfers':>5} {'host_ms':>8} "
+          f"{'dev_ms':>8} {'share':>6}")
     for o in occ:
+        tot = o["host_ms"] + o["device_ms"]
+        share = o["host_ms"] / tot if tot > 0 else 0.0
         print(f"{o['segment']:>4} {o['steps']:>6} {o['live']:>5} "
-              f"{o['idle']:>5} {o['refilled']:>6} {o['queue']:>5}")
+              f"{o['idle']:>5} {o['refilled']:>6} {o['queue']:>5} "
+              f"{o['transfers']:>5} {o['host_ms']:>8.2f} "
+              f"{o['device_ms']:>8.2f} {share:>6.3f}")
     print(f"positions {done}/{n} done, width {args.lanes}, "
           f"{len(occ)} segments, {out['refills']} refills, "
-          f"mean live fraction {mean_live:.3f}, wall {wall:.2f}s")
+          f"mean live fraction {mean_live:.3f}, "
+          f"boundary share {boundary_share:.3f} "
+          f"({transfers} transfers), wall {wall:.2f}s")
     if args.json:
         print("OCCUPANCY " + json.dumps({
             "lanes": args.lanes, "positions": n, "done": done,
             "segments": len(occ), "refills": out["refills"],
             "mean_live_frac": round(mean_live, 4),
+            "host_ms": round(host_ms, 1),
+            "device_ms": round(device_ms, 1),
+            "boundary_share": round(boundary_share, 4),
+            "transfers": transfers,
             "wall_s": round(wall, 3),
         }))
+
+    if legacy is not None:
+        lout, lwall = legacy
+        diverged = []
+        for key in ("score", "move", "nodes", "pv_len", "pv", "done"):
+            if not np.array_equal(np.asarray(lout[key]),
+                                  np.asarray(out[key])):
+                diverged.append(key)
+        lx = sum(o["transfers"] for o in lout["occupancy"])
+        print(f"pipeline A/B: legacy {lwall:.2f}s / pipelined {wall:.2f}s "
+              f"({lwall / max(wall, 1e-9):.2f}x), transfers {lx} -> "
+              f"{transfers}")
+        if diverged:
+            msg = (f"pipelined results diverge from the synchronous loop "
+                   f"on: {', '.join(diverged)} — the segment pipeline "
+                   "must be bit-identical")
+            if args.format == "github":
+                print(f"::error title=pipeline-ab divergence::{msg}")
+            else:
+                print(f"ERROR: {msg}")
+            return 1
 
     if done < n:
         msg = (f"only {done}/{n} positions finished — raise --budget or "
@@ -159,6 +223,15 @@ def main() -> int:
                f"the stragglers finished")
         if args.format == "github":
             print(f"::warning title=occupancy-report::{msg}")
+        else:
+            print(f"WARNING: {msg}")
+    if boundary_share > args.host_share_threshold:
+        msg = (f"boundary host share {boundary_share:.3f} exceeds "
+               f"{args.host_share_threshold} — the host is stalling the "
+               "device at segment boundaries; shrink the boundary work "
+               "or raise FISHNET_TPU_SEGMENT (=auto retunes it)")
+        if args.format == "github":
+            print(f"::warning title=occupancy-report host-share::{msg}")
         else:
             print(f"WARNING: {msg}")
     return 0

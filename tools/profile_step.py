@@ -50,15 +50,21 @@ def main() -> None:
     depth_arr = jnp.full((B,), depth, jnp.int32)
     budget_arr = jnp.full((B,), 10_000_000, jnp.int32)
 
-    state = S._init_state_jit(params, roots, depth_arr, budget_arr, max_ply,
-                              "standard")
-    jax.block_until_ready(state.bt)
-    tt0 = None
+    tt_mod = None
     if use_tt:
         from fishnet_tpu.ops import tt as tt_mod
 
-        tt0 = tt_mod.make_table(21)
+    def fresh_inputs():
+        # _run_segment_jit DONATES the state and table (ops/search.py),
+        # so every dispatch needs its own copies — rebuilding also keeps
+        # the step counts comparable across the timed runs
+        st = S._init_state_jit(params, roots, depth_arr, budget_arr,
+                               max_ply, "standard")
+        t = tt_mod.make_table(21) if use_tt else None
+        jax.block_until_ready(st.bt)
+        return st, t
 
+    state, tt0 = fresh_inputs()
     t0 = time.perf_counter()
     S._run_segment_jit.lower(params, state, tt0, steps, "standard",
                              False).compile()
@@ -67,9 +73,10 @@ def main() -> None:
 
     # warmup + timed: same fresh state each time so step counts match
     for tag in ("warmup", "timed1", "timed2", "timed3"):
+        state, tt0 = fresh_inputs()
         t0 = time.perf_counter()
-        out, _, n = S._run_segment_jit(params, state, tt0, steps, "standard",
-                                       False)
+        out, _, n, _summ = S._run_segment_jit(params, state, tt0, steps,
+                                              "standard", False)
         jax.block_until_ready(out.lane)
         dt = time.perf_counter() - t0
         n = int(n)
@@ -80,10 +87,11 @@ def main() -> None:
     if not do_trace:
         return
 
+    state, tt0 = fresh_inputs()
     trace_dir = os.environ.get("PROFILE_TRACE_DIR", "/tmp/fishnet-trace")
     with jax.profiler.trace(trace_dir):
-        out, _, n = S._run_segment_jit(params, state, tt0, steps, "standard",
-                                       False)
+        out, _, n, _summ = S._run_segment_jit(params, state, tt0, steps,
+                                              "standard", False)
         jax.block_until_ready(out.lane)
     print(f"trace written to {trace_dir}", file=sys.stderr)
 
