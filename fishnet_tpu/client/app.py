@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import os
 import signal
+from dataclasses import asdict
 from pathlib import Path
 from typing import Optional, Set
 
@@ -49,7 +50,7 @@ def tpu_variants_for(cfg: Config) -> Optional[Set[str]]:
     }
 
 
-def make_engine_factory(cfg: Config, logger: Logger):
+def make_engine_factory(cfg: Config, logger: Logger, stats=None):
     tpu_engine = None
 
     def factory(flavor: EngineFlavor):
@@ -70,6 +71,10 @@ def make_engine_factory(cfg: Config, logger: Logger):
                         helper_lanes=cfg.tpu_helpers,
                         refill=cfg.tpu_refill,
                         logger=logger,
+                        replay=cfg.tpu_replay,
+                        bisect_max=cfg.tpu_bisect_max,
+                        quarantine=cfg.tpu_quarantine,
+                        stats_recorder=stats,
                     )
                 else:
                     from ..engine.tpu import TpuEngine
@@ -97,6 +102,9 @@ def make_engine_factory(cfg: Config, logger: Logger):
                 return UciEngine(path, logger=logger, flavor=flavor)
         return PyEngine()
 
+    # non-creating accessor: the summary loop exports SupervisorStats
+    # without forcing an engine (and its warmup) into existence
+    factory.peek_tpu = lambda: tpu_engine
     return factory
 
 
@@ -174,7 +182,7 @@ async def run(cfg: Config) -> int:
     except NotImplementedError:
         pass  # non-unix
 
-    factory = make_engine_factory(cfg, logger)
+    factory = make_engine_factory(cfg, logger, stats=stats)
     if cfg.backend == "tpu":
         # pay the XLA compile cost now, before any chunk deadline ticks;
         # a flaky device at startup is non-fatal (workers retry per chunk)
@@ -217,6 +225,12 @@ async def run(cfg: Config) -> int:
         while True:
             await asyncio.sleep(SUMMARY_INTERVAL_S)
             logger.info(queue.stats_summary())
+            # recovery counters ride the same cadence into the SQLite
+            # sink, so quarantines/replays are visible next to occupancy
+            # (tools/occupancy_report.py --stats-db)
+            eng = factory.peek_tpu()
+            if eng is not None and hasattr(eng, "stats"):
+                stats.record_supervisor(asdict(eng.stats))
 
     summary = asyncio.ensure_future(summary_loop())
 

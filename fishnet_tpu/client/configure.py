@@ -105,6 +105,12 @@ class Config:
     # so a wedged device can be hard-killed and respawned; --no-supervisor
     # reverts to the in-process engine (debugging, single-process profiling)
     supervisor: bool = True
+    # session recovery (engine/supervisor.py recovery ladder). None defers
+    # to the FISHNET_TPU_REPLAY / _BISECT_MAX / _QUARANTINE registry
+    # settings so env-var config keeps working without CLI/ini mirrors.
+    tpu_replay: Optional[bool] = None
+    tpu_bisect_max: Optional[int] = None
+    tpu_quarantine: Optional[bool] = None
     user_backlog: Optional[float] = None
     system_backlog: Optional[float] = None
     max_backoff: float = 30.0
@@ -155,6 +161,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the TPU engine in-process instead of in a "
                         "supervised child process")
+    p.add_argument("--no-tpu-replay", action="store_true",
+                   help="disable partial-progress replay after an engine "
+                        "host death (whole-chunk retry semantics)")
+    p.add_argument("--tpu-bisect-max", type=int,
+                   help="child-death budget for the per-chunk recovery "
+                        "ladder (replay/bisect/quarantine)")
+    p.add_argument("--no-tpu-quarantine", action="store_true",
+                   help="never quarantine isolated poison positions to "
+                        "the CPU fallback")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")
     p.add_argument("--max-backoff", help="maximum backoff duration")
@@ -226,6 +241,20 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.supervisor = not (
         args.no_supervisor or supervisor_ini in ("0", "false", "no", "off")
     )
+    # tri-state recovery knobs: unset (None) defers to the settings
+    # registry, so FISHNET_TPU_REPLAY=0 et al. keep working
+    replay_ini = str(ini.get("tpu_replay", "")).strip().lower()
+    if args.no_tpu_replay or replay_ini in ("0", "false", "no", "off"):
+        cfg.tpu_replay = False
+    elif replay_ini:
+        cfg.tpu_replay = True
+    quarantine_ini = str(ini.get("tpu_quarantine", "")).strip().lower()
+    if args.no_tpu_quarantine or quarantine_ini in ("0", "false", "no", "off"):
+        cfg.tpu_quarantine = False
+    elif quarantine_ini:
+        cfg.tpu_quarantine = True
+    bisect_max = pick(args.tpu_bisect_max, "tpu_bisect_max")
+    cfg.tpu_bisect_max = int(bisect_max) if bisect_max is not None else None
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

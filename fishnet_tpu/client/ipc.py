@@ -6,6 +6,7 @@ may batch many chunks into one device dispatch.
 """
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -107,6 +108,31 @@ class PositionResponse:
             time_ms=int(self.time_s * 1000),
             nps=self.nps,
         )
+
+
+# ------------------------------------------------------------ fingerprints
+#
+# Stable identity of one position across child respawns and sub-chunk
+# re-dispatches: the supervisor's session journal, quarantine list, and
+# the host's `partial` frames all key on this (engine/supervisor.py).
+# Content-addressed (root_fen + moves + position_index), NOT keyed on
+# chunk/batch ids — the same poison position re-acquired in a later
+# batch must hit the quarantine list again.
+
+
+def _fingerprint(root_fen: str, moves: List[str], position_index) -> str:
+    key = "\x00".join([root_fen, " ".join(moves), str(position_index)])
+    return hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]
+
+
+def position_fingerprint(wp: WorkPosition) -> str:
+    return _fingerprint(wp.root_fen, wp.moves, wp.position_index)
+
+
+def wire_position_fingerprint(p: dict) -> str:
+    """Same hash over the chunk wire-dict form (engine/fakehost.py
+    computes fingerprints without constructing WorkPosition objects)."""
+    return _fingerprint(p["root_fen"], list(p["moves"]), p["position_index"])
 
 
 # -------------------------------------------------------- pipe-wire serde

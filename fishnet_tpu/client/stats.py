@@ -60,6 +60,8 @@ class StatsRecorder:
         self.stats = Stats()
         self._path: Optional[Path] = None
         self._db: Optional[sqlite3.Connection] = None
+        # latest SupervisorStats snapshot (engine/supervisor.py), if any
+        self.last_supervisor: Optional[dict] = None
 
         if not no_stats_file:
             self._path = stats_file or (Path.home() / ".fishnet-stats")
@@ -79,6 +81,23 @@ class StatsRecorder:
                         " total_positions INTEGER NOT NULL,"
                         " total_nodes INTEGER NOT NULL,"
                         " nnue_nps INTEGER NOT NULL)"
+                    )
+                    # supervisor recovery time series (engine/supervisor.py
+                    # SupervisorStats snapshots + the quarantine event log);
+                    # read back by tools/occupancy_report.py --stats-db
+                    self._db.execute(
+                        "CREATE TABLE IF NOT EXISTS supervisor_stats ("
+                        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                        " timestamp INTEGER NOT NULL,"
+                        " counters TEXT NOT NULL)"
+                    )
+                    self._db.execute(
+                        "CREATE TABLE IF NOT EXISTS supervisor_quarantine ("
+                        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                        " timestamp INTEGER NOT NULL,"
+                        " fingerprint TEXT NOT NULL,"
+                        " batch_id TEXT,"
+                        " position_index INTEGER)"
                     )
                     self._db.commit()
                 except sqlite3.Error:
@@ -107,6 +126,41 @@ class StatsRecorder:
                         self.stats.total_nodes,
                         nnue_nps or 0,
                     ),
+                )
+                self._db.commit()
+            except sqlite3.Error:
+                pass
+
+    def record_supervisor(self, counters: dict) -> None:
+        """Persist one SupervisorStats snapshot (dict of plain counters)
+        into the time-series sink; latest kept in memory regardless."""
+        self.last_supervisor = dict(counters)
+        if self._db is not None:
+            try:
+                self._db.execute(
+                    "INSERT INTO supervisor_stats (timestamp, counters)"
+                    " VALUES (?, ?)",
+                    (int(time.time()), json.dumps(self.last_supervisor)),
+                )
+                self._db.commit()
+            except sqlite3.Error:
+                pass
+
+    def record_quarantine(
+        self,
+        fingerprint: str,
+        batch_id: Optional[str] = None,
+        position_index: Optional[int] = None,
+    ) -> None:
+        """Persist one poison-position quarantine event (called from the
+        supervisor's recovery ladder)."""
+        if self._db is not None:
+            try:
+                self._db.execute(
+                    "INSERT INTO supervisor_quarantine"
+                    " (timestamp, fingerprint, batch_id, position_index)"
+                    " VALUES (?, ?, ?, ?)",
+                    (int(time.time()), fingerprint, batch_id, position_index),
                 )
                 self._db.commit()
             except sqlite3.Error:

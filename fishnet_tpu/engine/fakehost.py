@@ -32,6 +32,28 @@ Actions:
     crash:N     exit immediately with status N
     corrupt     write garbage bytes into the frame stream
     err         reply with an err frame (host stays alive)
+
+Session-recovery actions (round 9) — these stream `partial` frames so
+the supervisor's journal/replay/bisect/quarantine ladder is exercisable
+deterministically (tests/test_recovery.py, tools/chaos.py --scenario):
+
+    partial-ok[:CP]  a partial frame per position, then ok
+    dup-partial      every partial sent twice (exactly-once check), then ok
+    die-after:N      N partials, then exit 9 (kill-after-k-partials)
+    stall-at:N       N partials, then stop ALL output (watchdog kill)
+    hang-at:N        N partials, then heartbeat-only silence — killed at
+                     the deadline, or earlier by progress_timeout
+    crash-on-fp:P    stream partials per position in order, but exit 9 on
+                     the position whose fingerprint starts with P — the
+                     deterministic poison position the ladder must isolate
+
+The `--echo PATH` flag appends one JSON line per boot ({"t":"boot",
+argv, FISHNET_TPU_* env}) and per chunk ({"t":"go", positions, fps}) so
+tests can assert the respawned child re-received the full engine config
+and exactly which positions each incarnation was asked to search.
+Engine-config flags of the real host (--backend/--weights/--depth/
+--helpers/--refill/--partials/--hb-interval) are accepted and echoed,
+never interpreted.
 """
 from __future__ import annotations
 
@@ -42,6 +64,7 @@ import sys
 import threading
 import time
 
+from ..client.ipc import wire_position_fingerprint
 from .frames import FrameError, PipeClosed, read_frame, write_frame
 
 FAKE_CP = 777  # default signature score for "ok" responses
@@ -62,6 +85,11 @@ NAMED_SCRIPTS = {
     "boot-stall": {"boot": ["stall", "ready"]},
     "boot-crash": {"boot": ["crash:7", "ready"]},
     "boot-slow": {"boot": ["slow:3.0"]},
+    # session-recovery ladder rungs (round 9)
+    "partials": {"chunks": ["partial-ok"]},
+    "die-mid-chunk": {"chunks": ["die-after:2", "partial-ok"]},
+    "hang-mid-chunk": {"chunks": ["hang-at:1", "partial-ok"]},
+    "dup-partial": {"chunks": ["dup-partial"]},
 }
 
 
@@ -105,6 +133,20 @@ class _State:
         return n
 
 
+def _fake_response(wp: dict, cp: int) -> dict:
+    return {
+        "position_index": wp.get("position_index"),
+        "url": wp.get("url"),
+        "scores": [[None, {"cp": cp}]],
+        "pvs": [[None, ["e2e4"]]],
+        "best_move": "e2e4",
+        "depth": 1,
+        "nodes": 1,
+        "time_s": 0.001,
+        "nps": 1000,
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="fishnet-tpu-fake-host")
     p.add_argument("--script", required=True,
@@ -112,12 +154,37 @@ def main(argv=None) -> int:
     p.add_argument("--state", default=None,
                    help="JSON file persisting script position across respawns")
     p.add_argument("--hb-interval", type=float, default=0.05)
+    p.add_argument("--echo", default=None,
+                   help="append one JSON line per boot/chunk for config-"
+                        "fidelity and replay-suffix assertions")
+    # engine-config flags of the real host (engine/host.py): accepted so
+    # a supervisor-built host_cmd works verbatim; echoed, not interpreted
+    p.add_argument("--backend", default=None)
+    p.add_argument("--weights", default=None)
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--helpers", type=int, default=None)
+    p.add_argument("--refill", type=int, default=None)
+    p.add_argument("--partials", type=int, default=1)
     args = p.parse_args(argv)
 
     script = _load_script(args.script)
     state = _State(args.state)
     stdin = sys.stdin.buffer
     stdout = sys.stdout.buffer
+
+    def echo(record: dict) -> None:
+        if args.echo:
+            with open(args.echo, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    echo({
+        "t": "boot",
+        "argv": list(argv) if argv is not None else sys.argv[1:],
+        "env": {
+            k: v for k, v in os.environ.items()
+            if k.startswith("FISHNET_TPU_")
+        },
+    })
 
     wlock = threading.Lock()
     stalled = threading.Event()
@@ -161,7 +228,22 @@ def main(argv=None) -> int:
             return 0
         if t != "go":
             continue
+        gid = msg.get("id")
+        positions = msg.get("chunk", {}).get("positions", [])
+        fps = [wire_position_fingerprint(wp) for wp in positions]
+        echo({"t": "go", "positions": len(positions), "fps": fps})
         action = _action(script.get("chunks"), state.bump("chunks"), "ok")
+
+        def send_partial(wp: dict, times: int = 1, cp: int = FAKE_CP) -> None:
+            frame = {
+                "t": "partial",
+                "id": gid,
+                "fp": wire_position_fingerprint(wp),
+                "response": _fake_response(wp, cp),
+            }
+            for _ in range(times):
+                send(frame)
+
         if action.startswith("crash:"):
             os._exit(int(action.split(":", 1)[1]))
         elif action == "stall":
@@ -175,34 +257,61 @@ def main(argv=None) -> int:
                 stdout.flush()
             freeze()
         elif action == "err":
-            send({"t": "err", "id": msg.get("id"),
-                  "error": "scripted engine error"})
+            send({"t": "err", "id": gid, "error": "scripted engine error"})
             continue
+        elif action.startswith("die-after:"):
+            # k positions finish and stream out, then the child dies —
+            # the supervisor must replay only the unfinished suffix
+            k = int(action.split(":", 1)[1])
+            for wp in positions[:k]:
+                send_partial(wp)
+            time.sleep(2 * args.hb_interval)  # let the frames flush
+            os._exit(9)
+        elif action.startswith("stall-at:"):
+            k = int(action.split(":", 1)[1])
+            for wp in positions[:k]:
+                send_partial(wp)
+            freeze()
+        elif action.startswith("hang-at:"):
+            # the device-hang signature mid-chunk: partial stream stops,
+            # heartbeats keep flowing
+            k = int(action.split(":", 1)[1])
+            for wp in positions[:k]:
+                send_partial(wp)
+            while True:
+                time.sleep(3600)
+        elif action.startswith("crash-on-fp:"):
+            # deterministic poison position, addressed by fingerprint so
+            # it stays poison across replays/bisections/batches
+            prefix = action.split(":", 1)[1]
+            for wp in positions:
+                if wire_position_fingerprint(wp).startswith(prefix):
+                    time.sleep(2 * args.hb_interval)
+                    os._exit(9)
+                send_partial(wp)
+            send({"t": "ok", "id": gid,
+                  "responses": [_fake_response(wp, FAKE_CP)
+                                for wp in positions]})
+        elif action == "dup-partial":
+            for wp in positions:
+                send_partial(wp, times=2)
+            send({"t": "ok", "id": gid,
+                  "responses": [_fake_response(wp, FAKE_CP)
+                                for wp in positions]})
         else:
             cp = FAKE_CP
             if action.startswith("slow:"):
                 time.sleep(float(action.split(":", 1)[1]))
             elif action.startswith("ok:"):
                 cp = int(action.split(":", 1)[1])
-            positions = msg.get("chunk", {}).get("positions", [])
-            send({
-                "t": "ok",
-                "id": msg.get("id"),
-                "responses": [
-                    {
-                        "position_index": wp.get("position_index"),
-                        "url": wp.get("url"),
-                        "scores": [[None, {"cp": cp}]],
-                        "pvs": [[None, ["e2e4"]]],
-                        "best_move": "e2e4",
-                        "depth": 1,
-                        "nodes": 1,
-                        "time_s": 0.001,
-                        "nps": 1000,
-                    }
-                    for wp in positions
-                ],
-            })
+            elif action.startswith("partial-ok"):
+                part = action.split(":", 1)
+                if len(part) == 2:
+                    cp = int(part[1])
+                for wp in positions:
+                    send_partial(wp, cp=cp)
+            send({"t": "ok", "id": gid,
+                  "responses": [_fake_response(wp, cp) for wp in positions]})
 
 
 if __name__ == "__main__":

@@ -7,6 +7,9 @@ bounded so a garbage header can't trigger an unbounded read.
 
 Used on both sides of the pipe: synchronous helpers for the child host
 (blocking stdio) and an asyncio helper for the parent supervisor.
+Incremental `partial` frames (one position's response each, for the
+supervisor's session journal) are single-position and sit far under
+MAX_FRAME_BYTES by construction.
 """
 from __future__ import annotations
 

@@ -13,6 +13,10 @@ Protocol (all frames are JSON objects with a "t" tag):
     hb     {phase, busy_s, seq}   ticker thread, every --hb-interval
     ready  {}                     warmup finished; chunks may be sent
     log    {msg}                  relayed to the parent's logger
+    partial {id, fp, response}    one finished position, streamed as the
+                                  engine's exactly-once delivery hook
+                                  fires (feeds the supervisor's session
+                                  journal; fp = client/ipc.py fingerprint)
     ok     {id, responses}        chunk result (client/ipc.py wire form)
     err    {id, error}            chunk failed but the host is still sane
   parent → child
@@ -37,7 +41,7 @@ import os
 import sys
 import threading
 
-from ..client.ipc import chunk_from_wire, response_to_wire
+from ..client.ipc import chunk_from_wire, position_fingerprint, response_to_wire
 from ..utils.heartbeat import PhaseTracker
 from .frames import FrameError, PipeClosed, read_frame, write_frame
 
@@ -77,6 +81,9 @@ def main(argv=None) -> int:
     # continuous lane refill (engine/tpu.py LaneScheduler); None defers
     # to FISHNET_TPU_REFILL / the engine default, 0 disables
     p.add_argument("--refill", type=int, default=None)
+    # stream per-position `partial` frames for the supervisor's session
+    # journal (engine/supervisor.py recovery ladder); 0 disables
+    p.add_argument("--partials", type=int, default=1)
     p.add_argument("--hb-interval", type=float, default=1.0)
     p.add_argument("--skip-warmup", action="store_true")
     args = p.parse_args(argv)
@@ -121,6 +128,25 @@ def main(argv=None) -> int:
     send({"t": "ready"})
     phases.enter("idle")
 
+    # stream each finished position the moment the engine's exactly-once
+    # delivery hook fires (engine/tpu.py LaneScheduler._deliver), tagged
+    # with the in-flight go id so the supervisor can journal it
+    cur = {"id": None}
+
+    def emit_partial(wp, res) -> None:
+        try:
+            send({
+                "t": "partial",
+                "id": cur["id"],
+                "fp": position_fingerprint(wp),
+                "response": response_to_wire(res),
+            })
+        except OSError:
+            pass  # parent gone mid-stream; the ticker exits for us
+
+    if args.partials and hasattr(engine, "on_response"):
+        engine.on_response = emit_partial
+
     while True:
         try:
             msg = read_frame(stdin)
@@ -136,6 +162,7 @@ def main(argv=None) -> int:
             log(f"ignoring unknown frame type {t!r}")
             continue
         chunk = chunk_from_wire(msg["chunk"])
+        cur["id"] = msg.get("id")
         phases.enter("search")
         try:
             responses = asyncio.run(engine.go_multiple(chunk))

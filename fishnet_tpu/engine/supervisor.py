@@ -17,17 +17,32 @@ by hosting the engine in a child process (engine/host.py) behind the
   successful chunk) and re-runs the child's warmup, whose long XLA
   compiles are covered by warmup-phase heartbeats rather than a fixed
   timeout.
+- **Session recovery** (round 9): the child streams each finished
+  position as a `partial` frame (engine/host.py, fed by the
+  LaneScheduler's exactly-once delivery hook) into an in-memory session
+  journal keyed by position fingerprint (client/ipc.py). After a kill,
+  the recovery ladder re-dispatches only the unfinished suffix
+  (*replay*); a residual set that fails twice without progress is split
+  in half (*bisection*) until the faulting position is isolated; an
+  isolated poison position is *quarantined* — routed to the CPU
+  fallback individually, this chunk and every later chunk, while the
+  rest of the work stays on the TPU path. Failure becomes a
+  per-position event instead of a per-engine event.
 - **Circuit breaker**: after `breaker_threshold` child deaths within
   `breaker_window` seconds, the flavor degrades to the pure-Python CPU
   engine (engine/pyengine.py) so the client keeps acquiring and
   submitting work while the device is wedged. Every `probe_interval`
   seconds one chunk probes the child path; a successful probe restores
-  it.
+  it. Deaths the recovery ladder absorbs (it will replay/bisect/
+  quarantine within the chunk) do NOT feed the breaker window — only
+  one breaker-visible death is recorded when the ladder gives up, so a
+  single poison position can no longer trip the whole-engine breaker.
 
 Fault paths are exercised deterministically by pointing `host_cmd` at
 the scriptable fake host (engine/fakehost.py); tests/test_supervisor.py
-covers every branch on CPU, and tools/chaos.py replays the same scripts
-interactively.
+and tests/test_recovery.py cover every branch on CPU, and
+tools/chaos.py replays the same scripts interactively (`--scenario`
+runs the CI acceptance ladder end-to-end).
 """
 from __future__ import annotations
 
@@ -36,12 +51,19 @@ import os
 import sys
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from pathlib import Path
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from ..client.backoff import RandomizedBackoff
-from ..client.ipc import Chunk, PositionResponse, chunk_to_wire, responses_from_wire
+from ..client.ipc import (
+    Chunk,
+    PositionResponse,
+    WorkPosition,
+    chunk_to_wire,
+    position_fingerprint,
+    responses_from_wire,
+)
 from ..client.logger import Logger
 from ..utils import settings
 from .base import EngineError
@@ -59,6 +81,7 @@ def default_host_cmd(
     hb_interval: float = 1.0,
     helpers: Optional[int] = None,
     refill: Optional[bool] = None,
+    partials: Optional[bool] = None,
 ) -> List[str]:
     cmd = [
         sys.executable, "-m", "fishnet_tpu.engine.host",
@@ -74,6 +97,10 @@ def default_host_cmd(
     if refill is not None:
         # continuous lane refill (engine/tpu.py LaneScheduler); 0 disables
         cmd += ["--refill", "1" if refill else "0"]
+    if partials is not None:
+        # incremental per-position result streaming for the supervisor's
+        # session journal (engine/host.py partial frames); 0 disables
+        cmd += ["--partials", "1" if partials else "0"]
     return cmd
 
 
@@ -92,6 +119,20 @@ class SupervisorStats:
     probes: int = 0
     fallback_chunks: int = 0
     chunks_ok: int = 0
+    # session recovery (round 9)
+    partials: int = 0            # partial frames journaled
+    duplicate_partials: int = 0  # exactly-once: re-sent partials ignored
+    replays: int = 0             # re-dispatches resumed with a journal-shrunk suffix
+    replayed_positions: int = 0  # positions recovered from the journal, not re-searched
+    bisections: int = 0          # residual splits isolating a faulting position
+    quarantined: int = 0         # poison positions routed individually to CPU
+    quarantine_routed: int = 0   # positions pre-routed via the quarantine list
+    progress_stalls: int = 0     # kills for a stalled partial stream
+
+
+class _ChildErrReply(EngineError):
+    """`err` reply frame: the child handled the failure itself and is
+    still sane — not a death, never retried by the recovery ladder."""
 
 
 def _consume_exc(fut: asyncio.Future) -> None:
@@ -127,10 +168,34 @@ class SupervisedEngine:
         fallback_factory=None,
         backoff: Optional[RandomizedBackoff] = None,
         env: Optional[dict] = None,
+        replay: Optional[bool] = None,
+        bisect_max: Optional[int] = None,
+        quarantine: Optional[bool] = None,
+        progress_timeout: Optional[float] = None,
+        stats_recorder=None,
     ) -> None:
+        # session-recovery policy (None defers to the settings registry)
+        self.replay = (
+            settings.get_bool("FISHNET_TPU_REPLAY")
+            if replay is None else bool(replay)
+        )
+        self.bisect_max = (
+            settings.get_int("FISHNET_TPU_BISECT_MAX")
+            if bisect_max is None else int(bisect_max)
+        )
+        self.quarantine_on = (
+            settings.get_bool("FISHNET_TPU_QUARANTINE")
+            if quarantine is None else bool(quarantine)
+        )
+        # optional hang bisection: with >=1 partial delivered this
+        # dispatch, a partial stream silent for this long is killed even
+        # though heartbeats flow — the device-hang signature caught
+        # before the deadline, leaving the ladder time to bisect
+        self.progress_timeout = progress_timeout
         self.host_cmd = host_cmd or default_host_cmd(
             backend=backend, weights=weights_path, depth=max_depth,
             hb_interval=hb_interval, helpers=helper_lanes, refill=refill,
+            partials=self.replay,
         )
         self.logger = logger or Logger()
         self.hb_interval = hb_interval
@@ -160,6 +225,18 @@ class SupervisedEngine:
         self._breaker_open = False
         self._next_probe = 0.0
         self._fallback = None
+        # session journal: fp -> wire response, filled by partial frames
+        # from the CURRENT dispatch. Single-writer invariant (lint rule
+        # conc-journal-writer): mutated only via _journal_record /
+        # _journal_reset, so the recovery ladder can trust its contents.
+        self._journal: Dict[str, dict] = {}
+        self._journal_expect: Set[str] = set()
+        self._last_partial: Optional[float] = None
+        # poison positions (by content fingerprint), routed individually
+        # to the CPU fallback for the rest of this process's life
+        self._quarantine: Set[str] = set()
+        self._ladder_active = False
+        self._stats_recorder = stats_recorder
 
     # ------------------------------------------------------------ lifecycle
 
@@ -205,7 +282,9 @@ class SupervisedEngine:
                         "Circuit breaker: probing the supervised engine path"
                     )
                     try:
-                        responses = await self._go_child(chunk)
+                        # probes bypass the recovery ladder: one cheap
+                        # dispatch decides whether the child path is back
+                        responses = await self._go_child(chunk, probe=True)
                     except EngineError as e:
                         self._next_probe = time.monotonic() + self.probe_interval
                         self.logger.warn(
@@ -244,16 +323,62 @@ class SupervisedEngine:
         except Exception as e:
             raise EngineError(f"fallback engine failed: {e}") from e
 
-    async def _go_child(self, chunk: Chunk) -> List[PositionResponse]:
+    async def _go_child(
+        self, chunk: Chunk, probe: bool = False
+    ) -> List[PositionResponse]:
         deadline = chunk.deadline - self.deadline_margin
+        pairs = [(wp, position_fingerprint(wp)) for wp in chunk.positions]
+        if probe or not self.replay:
+            # legacy whole-chunk semantics: one dispatch, all-or-nothing
+            responses = await self._dispatch_once(
+                chunk, [wp for wp, _ in pairs], deadline
+            )
+            self.stats.chunks_ok += 1
+            return responses
+
+        results: Dict[str, PositionResponse] = {}
+        healthy: List[Tuple[WorkPosition, str]] = []
+        routed: List[Tuple[WorkPosition, str]] = []
+        for wp, fp in pairs:
+            if self.quarantine_on and fp in self._quarantine:
+                routed.append((wp, fp))
+            else:
+                healthy.append((wp, fp))
+        if healthy:
+            await self._run_ladder(chunk, healthy, results, deadline)
+        for wp, fp in routed:
+            # known-poison positions go straight to the CPU fallback,
+            # one at a time, without risking the child
+            self.stats.quarantine_routed += 1
+            results[fp] = await self._go_quarantined(chunk, wp)
+        self.stats.chunks_ok += 1
+        return [results[fp] for _, fp in pairs]
+
+    async def _dispatch_once(
+        self, chunk: Chunk, wps: List[WorkPosition], deadline: Optional[float]
+    ) -> List[PositionResponse]:
+        """One go/ok round-trip for a (sub-)chunk. Success clears the
+        breaker window and resets the respawn backoff; an `err` reply
+        raises `_ChildErrReply`; any death/kill raises plain EngineError
+        (the recovery ladder's cue to harvest the journal and retry)."""
+        # clear stale journal state BEFORE _ensure_ready: a leftover
+        # _last_partial from a killed dispatch must not trigger a
+        # progress-stall kill during the respawned child's warmup
+        self._journal_reset()
+        self._last_partial = None
         await self._ensure_ready(deadline)
+        sub = (
+            chunk if len(wps) == len(chunk.positions)
+            else replace(chunk, positions=list(wps))
+        )
         self._go_id += 1
         gid = self._go_id
         fut = asyncio.get_running_loop().create_future()
         fut.add_done_callback(_consume_exc)
+        self._journal_reset(expect=[position_fingerprint(wp) for wp in wps])
         self._pending = (gid, fut)
         try:
-            await self._send({"t": "go", "id": gid, "chunk": chunk_to_wire(chunk)})
+            await self._send({"t": "go", "id": gid, "chunk": chunk_to_wire(sub)})
             reply = await self._watch(
                 fut, deadline, kill_on_deadline=True,
                 label=f"chunk of batch {chunk.work.id}",
@@ -262,17 +387,181 @@ class SupervisedEngine:
             self._pending = None
         if reply.get("t") == "err":
             # the child handled the failure itself and is still sane
-            raise EngineError(f"engine host: {reply.get('error')}")
+            raise _ChildErrReply(f"engine host: {reply.get('error')}")
         try:
             responses = responses_from_wire(chunk.work, reply["responses"])
         except (KeyError, TypeError, ValueError) as e:
             self.stats.protocol_errors += 1
             await self._kill(f"malformed ok frame: {e}")
             raise EngineError(f"engine host sent a malformed result: {e}") from e
+        if len(responses) != len(wps):
+            self.stats.protocol_errors += 1
+            await self._kill(
+                f"ok frame carries {len(responses)} responses "
+                f"for {len(wps)} positions"
+            )
+            raise EngineError("engine host returned a mismatched result count")
         self._deaths.clear()
         self._backoff.reset()
-        self.stats.chunks_ok += 1
         return responses
+
+    # ------------------------------------------------------ recovery ladder
+
+    async def _run_ladder(
+        self,
+        chunk: Chunk,
+        pairs: List[Tuple[WorkPosition, str]],
+        results: Dict[str, PositionResponse],
+        deadline: float,
+    ) -> None:
+        """Replay → bisect → quarantine. Work is a queue of position
+        groups (initially one group: the whole chunk). A failed dispatch
+        first harvests finished positions from the session journal; a
+        shrunken residual is simply retried (*replay*). A residual that
+        fails twice with no progress is split in half (*bisection*,
+        consistent with docs/tpu-hang.md: B=8 is clean at shapes where
+        B>=16 faults) until the faulting position is isolated; an
+        isolated repeat offender is *quarantined* to the CPU fallback.
+        The death budget (`bisect_max`), the chunk deadline, and the
+        backoff-vs-deadline check in `_ensure_ready` bound the ladder."""
+        queue: Deque[List[Tuple[WorkPosition, str]]] = deque([list(pairs)])
+        fail_counts: Dict[Tuple[str, ...], int] = {}
+        attempts = 0
+        self._ladder_active = True
+        try:
+            while queue:
+                group = queue.popleft()
+                try:
+                    responses = await self._dispatch_once(
+                        chunk, [wp for wp, _ in group], deadline
+                    )
+                except _ChildErrReply:
+                    raise
+                except EngineError as e:
+                    attempts += 1
+                    harvested = self._harvest(chunk, group, results)
+                    residual = [
+                        (wp, fp) for wp, fp in group if fp not in results
+                    ]
+                    if not residual:
+                        # every position of the group was already streamed
+                        self.stats.replays += 1
+                        self.stats.replayed_positions += harvested
+                        continue
+                    now = time.monotonic()
+                    if now >= deadline:
+                        self._breaker_count(f"{e}")
+                        raise
+                    if attempts > self.bisect_max:
+                        self._breaker_count(f"{e}")
+                        raise EngineError(
+                            f"recovery ladder exhausted after {attempts} "
+                            f"child deaths for batch {chunk.work.id}: {e}"
+                        ) from e
+                    if harvested:
+                        # progress: hand the respawned child the suffix
+                        self.stats.replays += 1
+                        self.stats.replayed_positions += harvested
+                        self.logger.warn(
+                            f"Replaying {len(residual)} unfinished of "
+                            f"{len(group)} positions after: {e}"
+                        )
+                        queue.appendleft(residual)
+                        continue
+                    gkey = tuple(fp for _, fp in residual)
+                    fails = fail_counts.get(gkey, 0) + 1
+                    fail_counts[gkey] = fails
+                    if fails < 2:
+                        queue.appendleft(residual)  # plain retry
+                    elif len(residual) == 1:
+                        wp, fp = residual[0]
+                        if not self.quarantine_on:
+                            self._breaker_count(f"{e}")
+                            raise
+                        self._quarantine_add(fp, wp, chunk)
+                        results[fp] = await self._go_quarantined(chunk, wp)
+                    else:
+                        mid = len(residual) // 2
+                        self.stats.bisections += 1
+                        self.logger.warn(
+                            f"Bisecting a {len(residual)}-position "
+                            f"residual that failed twice ({e})"
+                        )
+                        queue.appendleft(residual[mid:])
+                        queue.appendleft(residual[:mid])
+                else:
+                    for (wp, fp), res in zip(group, responses):
+                        results[fp] = res  # ok reply wins over any partial
+        finally:
+            self._ladder_active = False
+
+    def _harvest(
+        self,
+        chunk: Chunk,
+        group: List[Tuple[WorkPosition, str]],
+        results: Dict[str, PositionResponse],
+    ) -> int:
+        """Recover journaled partials of a failed dispatch into results.
+        Returns how many positions were saved from re-search."""
+        harvested = 0
+        for wp, fp in group:
+            wire = self._journal.get(fp)
+            if wire is None or fp in results:
+                continue
+            try:
+                results[fp] = responses_from_wire(chunk.work, [wire])[0]
+            except (KeyError, TypeError, ValueError):
+                self.stats.protocol_errors += 1
+                continue  # malformed journal entry: just re-search it
+            harvested += 1
+        return harvested
+
+    async def _go_quarantined(
+        self, chunk: Chunk, wp: WorkPosition
+    ) -> PositionResponse:
+        responses = await self._go_fallback(replace(chunk, positions=[wp]))
+        if len(responses) != 1:
+            raise EngineError(
+                "fallback engine returned a mismatched result count"
+            )
+        return responses[0]
+
+    def _quarantine_add(self, fp: str, wp: WorkPosition, chunk: Chunk) -> None:
+        self._quarantine.add(fp)
+        self.stats.quarantined += 1
+        self.logger.error(
+            f"Quarantined poison position {fp} (batch {chunk.work.id}, "
+            f"index {wp.position_index}): it alone goes to the CPU "
+            "fallback; the rest of the chunk stays on the engine path"
+        )
+        if self._stats_recorder is not None:
+            try:
+                self._stats_recorder.record_quarantine(
+                    fp, str(chunk.work.id), wp.position_index
+                )
+            except Exception as e:
+                self.logger.warn(f"quarantine sink write failed: {e}")
+
+    # ------------------------------------------------------ session journal
+
+    def _journal_reset(self, expect=()) -> None:
+        """Start a fresh journal for one dispatch (with _journal_record,
+        the ONLY write path — lint rule conc-journal-writer)."""
+        self._journal = {}
+        self._journal_expect = set(expect)
+
+    def _journal_record(self, fp: str, wire: dict) -> None:
+        """Deliver one partial frame into the journal: the single write
+        path (lint rule conc-journal-writer), called only from the
+        reader task so the ladder can trust exactly-once contents."""
+        if fp not in self._journal_expect:
+            return  # stale or alien fingerprint
+        if fp in self._journal:
+            self.stats.duplicate_partials += 1
+            return  # exactly-once: re-sent partials are ignored
+        self._journal[fp] = wire
+        self.stats.partials += 1
+        self._last_partial = time.monotonic()
 
     # ------------------------------------------------------------- watchdog
 
@@ -302,10 +591,35 @@ class SupervisedEngine:
                     )
                     raise EngineError(f"{label} overran its deadline")
                 raise EngineError(f"engine host not ready in time for {label}")
+            if (
+                self.progress_timeout is not None
+                and self._last_partial is not None
+                and now - self._last_partial > self.progress_timeout
+            ):
+                # heartbeats flow but the partial stream went silent: the
+                # device-hang signature, caught while deadline budget
+                # remains for the recovery ladder to replay/bisect
+                self.stats.progress_stalls += 1
+                await self._kill(
+                    f"partial stream stalled for "
+                    f"{now - self._last_partial:.1f}s during {label}"
+                )
+                raise EngineError(
+                    f"engine host stopped streaming results during {label}"
+                )
             timeout = max(self.hb_timeout - hb_age, self.hb_interval / 4)
             if deadline is not None:
                 timeout = min(timeout, deadline - now)
-            await asyncio.wait([fut], timeout=max(timeout, 0.01))
+            if self.progress_timeout is not None and self._last_partial is not None:
+                timeout = min(
+                    timeout,
+                    self._last_partial + self.progress_timeout - now,
+                )
+            # the min() clamps above can go non-positive when a deadline
+            # passes between checks; floor it so wait() never gets <=0
+            # and the loop re-checks the policy branches promptly
+            timeout = max(timeout, 0.01)
+            await asyncio.wait([fut], timeout=timeout)
 
     async def _ensure_ready(self, deadline: Optional[float]) -> None:
         # _down_noted, not returncode: a crashed child's returncode stays
@@ -394,6 +708,20 @@ class SupervisedEngine:
                         fut = self._pending[1]
                         if not fut.done():
                             fut.set_result(msg)
+                elif t == "partial":
+                    # journal one streamed position for the in-flight
+                    # dispatch. Buffered partials are always drained
+                    # before this coroutine's finally fails the pending
+                    # future, so a post-crash harvest sees all of them.
+                    fp = msg.get("fp")
+                    wire = msg.get("response")
+                    if (
+                        self._pending is not None
+                        and self._pending[0] == msg.get("id")
+                        and isinstance(fp, str)
+                        and isinstance(wire, dict)
+                    ):
+                        self._journal_record(fp, wire)
                 elif t == "log":
                     self.logger.info(f"engine host: {msg.get('msg', '')}")
         except asyncio.CancelledError:
@@ -426,8 +754,10 @@ class SupervisedEngine:
             self.logger.error("Engine host ignored SIGKILL (unreapable?)")
 
     def _note_down(self, reason: str) -> None:
-        """Record one involuntary child death (idempotent per incarnation)
-        and trip the circuit breaker on the Nth within the window."""
+        """Record one involuntary child death (idempotent per incarnation).
+        Deaths the recovery ladder will absorb stay invisible to the
+        circuit breaker — the ladder records exactly one breaker-visible
+        death via `_breaker_count` if it gives up."""
         if self._down_noted:
             return
         self._down_noted = True
@@ -435,6 +765,14 @@ class SupervisedEngine:
             return  # voluntary shutdown, not a fault
         self.stats.deaths += 1
         self._backoff.next()  # arm the respawn delay
+        if self._ladder_active:
+            self.logger.warn(f"Engine host down: {reason} (recovery ladder active)")
+            return
+        self._breaker_count(reason)
+
+    def _breaker_count(self, reason: str) -> None:
+        """One breaker-window death; trips the breaker on the Nth within
+        the window."""
         now = time.monotonic()
         self._deaths.append(now)
         while self._deaths and now - self._deaths[0] > self.breaker_window:

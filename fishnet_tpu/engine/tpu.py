@@ -275,6 +275,11 @@ class TpuEngine:
         # fail_hi, nodes]} — the measured basis for ASPIRATION_DELTAS
         # (see docs/depth.md §"Aspiration deltas, measured")
         self.aspiration_stats: dict = {}
+        # exactly-once delivery hook: called as (wp, response) the moment
+        # a position's result is finalized, before the chunk completes.
+        # engine/host.py points this at its `partial` frame emitter so
+        # the supervisor's session journal sees incremental progress.
+        self.on_response = None
         # FISHNET_TPU_TRACE=1: per-dispatch / per-depth timing lines to
         # stderr (verdict A1: a hang or slow depth must be localizable
         # from logs — compile-vs-run shows up as a slow FIRST dispatch
@@ -1413,8 +1418,8 @@ class LaneScheduler:
                 game.append(pos)
                 pos = pos.push(pos.parse_uci(uci))
             if pos.outcome() is not None:
-                entry.responses[wp.position_index] = eng._terminal_response(
-                    chunk, wp, pos, 0.001
+                self._deliver(
+                    entry, wp, eng._terminal_response(chunk, wp, pos, 0.001)
                 )
                 continue
             hh, hm = TpuEngine._history_arrays([game], 1, variant)
@@ -1429,6 +1434,19 @@ class LaneScheduler:
             self._pending.extend(jobs)
         return entry
 
+    def _deliver(self, entry: _ChunkEntry, wp, response) -> None:
+        """Exactly-once delivery point for one position's result: every
+        finalized response — terminal shortcut or searched — lands in
+        `entry.responses` through here, and only here, so the
+        `on_response` streaming hook fires once per position."""
+        entry.responses[wp.position_index] = response
+        hook = self.engine.on_response
+        if hook is not None:
+            try:
+                hook(wp, response)
+            except Exception as e:
+                self.engine._warn(f"on_response hook failed: {e}")
+
     def _finalize(self, job: _RefillJob, now: float,
                   error: Optional[str] = None) -> None:
         entry = job.entry
@@ -1437,12 +1455,12 @@ class LaneScheduler:
         else:
             dt = max(now - entry.started, 1e-6)
             nps = int(job.nodes_total / dt) if job.nodes_total else None
-            entry.responses[job.wp.position_index] = PositionResponse(
+            self._deliver(entry, job.wp, PositionResponse(
                 work=entry.chunk.work, position_index=job.wp.position_index,
                 url=job.wp.url, scores=job.scores, pvs=job.pvs,
                 best_move=job.best_move, depth=job.depth_reached,
                 nodes=job.nodes_total, time_s=dt, nps=nps,
-            )
+            ))
             self.engine.occupancy_totals["positions_done"] += 1
         entry.n_open -= 1
         if entry.n_open <= 0:

@@ -19,6 +19,12 @@ that true:
                        a device-resident value inside the scheduler
                        loop — every such sync stalls the pipeline and
                        escapes the SyncStats transfer accounting
+  conc-journal-writer  the supervisor's session journal
+                       (self._journal / self._journal_expect) mutated
+                       outside its delivery path — the recovery ladder
+                       trusts exactly-once journal contents, so the
+                       single-writer invariant allows mutation only in
+                       _journal_record/_journal_reset/__init__
 
 Scopes: the timeout/lock rules run on the process-boundary modules
 (supervisor, host, uci, workers, queue); the except rules run on all of
@@ -63,6 +69,14 @@ EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine")
 
 # the scheduler loop: blocking host syncs here stall the segment pipeline
 HOST_SYNC_SCOPE = ("fishnet_tpu/engine/tpu.py",)
+
+# the session journal lives in the supervisor; its single-writer
+# invariant is what lets the recovery ladder trust exactly-once contents
+JOURNAL_SCOPE = ("fishnet_tpu/engine/supervisor.py",)
+_JOURNAL_ATTRS = ("_journal", "_journal_expect")
+_JOURNAL_WRITERS = ("_journal_record", "_journal_reset", "__init__")
+_MUT_METHODS = ("update", "pop", "clear", "setdefault", "popitem",
+                "add", "discard", "remove")
 
 # calls whose results are device arrays (or tuples of them); a local
 # `dispatch`/`flush_adm` closure wrapping the segment jit counts too
@@ -229,12 +243,67 @@ def _check_host_sync(src, findings: List[Finding]) -> None:
                     device.discard(name)
 
 
+def _journal_attr(node: ast.AST) -> str:
+    """'_journal'/'_journal_expect' if node is (a subscript of) that
+    attribute on self, else ''."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _JOURNAL_ATTRS and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+def _check_journal_writer(src, findings: List[Finding]) -> None:
+    """Single-writer invariant for the supervisor's session journal:
+    any rebind, item write, delete, or mutating method call on
+    self._journal / self._journal_expect outside the sanctioned delivery
+    path is a finding."""
+    parents = _parents(src.tree)
+
+    def enclosing_fn(node: ast.AST) -> str:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur.name
+            cur = parents.get(cur)
+        return ""
+
+    for node in ast.walk(src.tree):
+        name = ""
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                name = name or _journal_attr(t)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                name = name or _journal_attr(t)
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUT_METHODS:
+            name = _journal_attr(node.func.value)
+        if name and enclosing_fn(node) not in _JOURNAL_WRITERS:
+            findings.append(src.finding(
+                "conc-journal-writer", node,
+                f"self.{name} mutated outside the supervisor's delivery "
+                "path; the session journal is single-writer so the "
+                "recovery ladder can trust exactly-once contents — "
+                "route the write through _journal_record/_journal_reset",
+            ))
+
+
 @register_family("concurrency")
 def check_concurrency(project: Project) -> List[Finding]:
     findings: List[Finding] = []
 
     for src in project.in_dirs(*HOST_SYNC_SCOPE):
         _check_host_sync(src, findings)
+
+    for src in project.in_dirs(*JOURNAL_SCOPE):
+        _check_journal_writer(src, findings)
 
     for src in project.in_dirs(*BLOCK_SCOPE):
         parents = _parents(src.tree)

@@ -142,6 +142,35 @@ SETTINGS: Tuple[Setting, ...] = (
         engine=True,
     ),
     Setting(
+        name="FISHNET_TPU_REPLAY",
+        kind="bool",
+        default="1",
+        doc="Crash-safe session recovery (engine/supervisor.py): the "
+            "host streams per-position results as partial frames into "
+            "the supervisor's session journal, and after a kill the "
+            "respawned child is handed only the unfinished suffix of "
+            "the chunk (with bisection/quarantine for repeat offenders); "
+            "0 restores whole-chunk retry semantics.",
+    ),
+    Setting(
+        name="FISHNET_TPU_BISECT_MAX",
+        kind="int",
+        default="12",
+        doc="Child-death budget per chunk for the supervisor's recovery "
+            "ladder (replay retries + bisection splits + quarantine "
+            "probes); isolating one poison position in a 6-position "
+            "chunk costs up to 7 deaths.",
+    ),
+    Setting(
+        name="FISHNET_TPU_QUARANTINE",
+        kind="bool",
+        default="1",
+        doc="Route bisection-isolated poison positions to the CPU "
+            "fallback individually while the rest of the chunk stays on "
+            "the TPU path (engine/supervisor.py quarantine list); 0 "
+            "lets repeat offenders fail the chunk instead.",
+    ),
+    Setting(
         name="FISHNET_TPU_ASPIRATION",
         kind="csv-int",
         default="",

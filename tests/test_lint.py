@@ -496,6 +496,72 @@ def test_host_sync_scope_is_scheduler_module_only(tmp_path):
     assert by_rule(result.findings, "conc-host-sync") == []
 
 
+JOURNAL_BAD = '''
+class SupervisedEngine:
+    def __init__(self):
+        self._journal = {}
+        self._journal_expect = set()
+
+    def _journal_record(self, fp, wire):
+        self._journal[fp] = wire
+
+    def _read_loop(self, msg):
+        self._journal[msg["fp"]] = msg["response"]   # item write
+        self._journal = {}                           # rebind
+        self._journal.pop(msg["fp"], None)           # mutating method
+        self._journal_expect.add(msg["fp"])          # set mutator
+        del self._journal[msg["fp"]]                 # delete
+
+    def _harvest(self, fp):
+        return self._journal.get(fp)                 # read: fine
+'''
+
+JOURNAL_CLEAN = '''
+class SupervisedEngine:
+    def __init__(self):
+        self._journal = {}
+        self._journal_expect = set()
+
+    def _journal_reset(self, expect=()):
+        self._journal = {}
+        self._journal_expect = set(expect)
+
+    def _journal_record(self, fp, wire):
+        if fp in self._journal:
+            return
+        self._journal[fp] = wire
+
+    def _harvest(self, fp):
+        wire = self._journal.get(fp)
+        return wire if fp in self._journal_expect else None
+'''
+
+
+def test_journal_mutation_outside_delivery_path_flagged(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/supervisor.py": JOURNAL_BAD}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    flagged = by_rule(result.findings, "conc-journal-writer")
+    assert [f.line for f in flagged] == [11, 12, 13, 14, 15]
+
+
+def test_journal_single_writer_path_is_clean(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/supervisor.py": JOURNAL_CLEAN}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert by_rule(result.findings, "conc-journal-writer") == []
+
+
+def test_journal_rule_scope_is_supervisor_only(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/other.py": JOURNAL_BAD}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert by_rule(result.findings, "conc-journal-writer") == []
+
+
 # ------------------------------------------- suppressions, baseline, CLI
 
 

@@ -1,0 +1,263 @@
+"""Session-recovery ladder tests: replay → bisect → quarantine on CPU.
+
+Round-9 acceptance coverage (ISSUE 7): partial-progress replay after a
+mid-chunk kill re-searches strictly fewer positions than the chunk size;
+hang bisection isolates a fingerprint-addressed poison position; the
+quarantine list routes it (and only it) to the CPU fallback while every
+other position completes on the engine path bit-identical to a
+fault-free run. All driven through the scriptable fake host
+(fishnet_tpu/engine/fakehost.py) — no JAX, deterministic faults. One
+asyncio.run() per test.
+"""
+import asyncio
+import json
+import sys
+import time
+
+import pytest
+
+from fishnet_tpu.client.backoff import RandomizedBackoff
+from fishnet_tpu.client.ipc import Chunk, WorkPosition, position_fingerprint
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.fakehost import FAKE_CP
+from fishnet_tpu.engine.supervisor import SupervisedEngine
+
+pytestmark = pytest.mark.faultinject
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def fake_cmd(script, state_path=None, hb_interval=0.05, echo=None, extra=()):
+    cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", script if isinstance(script, str) else json.dumps(script),
+        "--hb-interval", str(hb_interval),
+    ]
+    if state_path is not None:
+        cmd += ["--state", str(state_path)]
+    if echo is not None:
+        cmd += ["--echo", str(echo)]
+    cmd += list(extra)
+    return cmd
+
+
+def make_supervisor(script, state_path=None, echo=None, extra=(), **kw):
+    kw.setdefault("hb_interval", 0.05)
+    kw.setdefault("hb_timeout", 0.6)
+    kw.setdefault("deadline_margin", 0.15)
+    kw.setdefault("logger", Logger(verbose=0))
+    kw.setdefault("backoff", RandomizedBackoff(max_s=0.05))
+    return SupervisedEngine(
+        fake_cmd(script, state_path, echo=echo, extra=extra), **kw
+    )
+
+
+def make_chunk(ttl=30.0, n_positions=4, depth=1):
+    work = AnalysisWork(
+        id="recjob01",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0,
+        depth=depth,
+        multipv=None,
+    )
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=START, moves=[])
+        for i in range(n_positions)
+    ]
+    return Chunk(work=work, deadline=time.monotonic() + ttl,
+                 variant="standard", flavor=EngineFlavor.TPU,
+                 positions=positions)
+
+
+class closing:
+    def __init__(self, sup):
+        self.sup = sup
+
+    async def __aenter__(self):
+        return self.sup
+
+    async def __aexit__(self, *exc):
+        await self.sup.close()
+
+
+def fake_cp(responses):
+    return [r.scores.best().value for r in responses]
+
+
+def read_echo(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_replay_resumes_suffix_after_mid_chunk_kill(tmp_path):
+    """Kill after k=2 partials of a 4-position chunk: the journal replays
+    the prefix, the respawned child is handed ONLY the 2-position suffix
+    (strictly fewer re-searched than chunk size), and delivery stays
+    exactly-once — no lost or duplicated PositionResponse."""
+    echo = tmp_path / "echo.jsonl"
+    async def main():
+        sup = make_supervisor({"chunks": ["die-after:2", "partial-ok"]},
+                              tmp_path / "state.json", echo=echo)
+        async with closing(sup):
+            chunk = make_chunk(n_positions=4)
+            responses = await sup.go_multiple(chunk)
+            # exactly-once end-to-end: every position exactly once, in order
+            assert [r.position_index for r in responses] == [0, 1, 2, 3]
+            assert fake_cp(responses) == [FAKE_CP] * 4
+            assert sup.stats.replays == 1
+            assert sup.stats.replayed_positions == 2
+            assert sup.stats.partials == 4  # 2 journaled + 2 from the retry
+            assert sup.stats.deaths == 1
+            assert sup.stats.quarantined == 0
+        # the respawned incarnation was asked to search ONLY the suffix
+        gos = [r for r in read_echo(echo) if r["t"] == "go"]
+        assert [g["positions"] for g in gos] == [4, 2]
+        fps = [position_fingerprint(wp) for wp in chunk.positions]
+        assert gos[0]["fps"] == fps
+        assert gos[1]["fps"] == fps[2:]  # strictly fewer than chunk size
+
+    asyncio.run(main())
+
+
+def test_progress_stall_killed_before_deadline(tmp_path):
+    """hang-at-segment signature: heartbeats flow but the partial stream
+    goes silent after 1 of 4 positions. progress_timeout must kill well
+    before the distant deadline and leave budget for in-chunk recovery."""
+    async def main():
+        sup = make_supervisor({"chunks": ["hang-at:1", "partial-ok"]},
+                              tmp_path / "state.json",
+                              progress_timeout=0.5)
+        async with closing(sup):
+            t0 = time.monotonic()
+            responses = await sup.go_multiple(make_chunk(ttl=30.0))
+            assert time.monotonic() - t0 < 10.0  # not the deadline
+            assert sup.stats.progress_stalls == 1
+            assert sup.stats.deadline_kills == 0
+            assert fake_cp(responses) == [FAKE_CP] * 4
+            assert sup.stats.replayed_positions == 1
+
+    asyncio.run(main())
+
+
+def test_quarantine_isolates_poison_position(tmp_path):
+    """crash-on-fingerprint: the ladder must end with EXACTLY the poison
+    position quarantined to the CPU fallback while all other positions
+    complete via the (fake) engine path, bit-identical to a fault-free
+    run — and a later chunk pre-routes the quarantined fingerprint with
+    zero additional child deaths."""
+    async def main():
+        # fault-free reference run
+        ref = make_supervisor({"chunks": ["partial-ok"]})
+        async with closing(ref):
+            ref_responses = await ref.go_multiple(make_chunk(n_positions=4))
+
+        chunk = make_chunk(n_positions=4)
+        poison = position_fingerprint(chunk.positions[2])
+        sup = make_supervisor({"chunks": [f"crash-on-fp:{poison}"]},
+                              tmp_path / "state.json")
+        async with closing(sup):
+            responses = await sup.go_multiple(chunk)
+            assert [r.position_index for r in responses] == [0, 1, 2, 3]
+            assert sup.stats.quarantined == 1
+            assert sup.stats.bisections >= 1
+            assert poison in sup._quarantine
+            assert len(sup._quarantine) == 1
+            # the ladder's deaths never tripped the breaker
+            assert not sup._breaker_open
+            assert sup.stats.breaker_trips == 0
+            # poison position answered by the real CPU fallback...
+            assert responses[2].scores.best().value != FAKE_CP
+            # ...every other position bit-identical to the fault-free run
+            for i in (0, 1, 3):
+                got, want = responses[i], ref_responses[i]
+                assert got.scores.best().value == want.scores.best().value
+                assert got.best_move == want.best_move
+                assert got.depth == want.depth
+                assert got.nodes == want.nodes
+
+            # second identical chunk: quarantine list pre-routes the
+            # poison fingerprint — no further child deaths at all
+            deaths = sup.stats.deaths
+            responses2 = await sup.go_multiple(make_chunk(n_positions=4))
+            assert sup.stats.quarantine_routed == 1
+            assert sup.stats.deaths == deaths
+            assert responses2[2].scores.best().value != FAKE_CP
+            assert fake_cp(responses2)[:2] == [FAKE_CP, FAKE_CP]
+
+    asyncio.run(main())
+
+
+def test_quarantine_disabled_surfaces_failure(tmp_path):
+    """quarantine=False: the isolated singleton is NOT routed to CPU —
+    the ladder gives up and the failure surfaces (legacy semantics)."""
+    async def main():
+        chunk = make_chunk(n_positions=2)
+        poison = position_fingerprint(chunk.positions[0])
+        sup = make_supervisor({"chunks": [f"crash-on-fp:{poison}"]},
+                              tmp_path / "state.json", quarantine=False)
+        async with closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(chunk)
+            assert sup.stats.quarantined == 0
+
+    asyncio.run(main())
+
+
+def test_duplicate_partials_are_ignored(tmp_path):
+    """Exactly-once journaling: a child that re-sends every partial twice
+    must not corrupt delivery; duplicates are counted, not stored."""
+    async def main():
+        sup = make_supervisor({"chunks": ["dup-partial"]},
+                              tmp_path / "state.json")
+        async with closing(sup):
+            responses = await sup.go_multiple(make_chunk(n_positions=3))
+            assert [r.position_index for r in responses] == [0, 1, 2]
+            assert fake_cp(responses) == [FAKE_CP] * 3
+            assert sup.stats.partials == 3
+            assert sup.stats.duplicate_partials == 3
+
+    asyncio.run(main())
+
+
+def test_bisect_budget_bounds_the_ladder(tmp_path):
+    """A chunk that dies on EVERY dispatch exhausts bisect_max and
+    surfaces an error instead of retrying forever."""
+    async def main():
+        sup = make_supervisor({"chunks": ["crash:9"]},
+                              tmp_path / "state.json", bisect_max=3)
+        async with closing(sup):
+            with pytest.raises(EngineError, match="exhausted|exited"):
+                await sup.go_multiple(make_chunk(n_positions=4))
+            assert sup.stats.deaths <= 4  # bisect_max + the final raise
+
+    asyncio.run(main())
+
+
+def test_respawn_rereceives_full_engine_config(tmp_path):
+    """Config fidelity across respawns: after a mid-chunk kill, the new
+    incarnation must come up with the SAME argv (helpers/refill/partials/
+    depth flags) and the same engine-affecting FISHNET_TPU_* env."""
+    echo = tmp_path / "echo.jsonl"
+    async def main():
+        sup = make_supervisor(
+            {"chunks": ["die-after:1", "partial-ok"]},
+            tmp_path / "state.json", echo=echo,
+            extra=["--helpers", "4", "--refill", "1",
+                   "--partials", "1", "--depth", "9"],
+            env={"FISHNET_TPU_HELPERS": "4"},
+        )
+        async with closing(sup):
+            responses = await sup.go_multiple(make_chunk(n_positions=3))
+            assert fake_cp(responses) == [FAKE_CP] * 3
+        boots = [r for r in read_echo(echo) if r["t"] == "boot"]
+        assert len(boots) == 2  # original + respawn
+        assert boots[1]["argv"] == boots[0]["argv"]
+        for flag in ("--helpers", "--refill", "--partials", "--depth"):
+            assert flag in boots[1]["argv"]
+        assert boots[1]["env"].get("FISHNET_TPU_HELPERS") == "4"
+        assert boots[1]["env"] == boots[0]["env"]
+
+    asyncio.run(main())

@@ -12,6 +12,7 @@ import time
 
 import pytest
 
+from fishnet_tpu.client.backoff import RandomizedBackoff
 from fishnet_tpu.client.ipc import Chunk, WorkPosition
 from fishnet_tpu.client.logger import Logger
 from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
@@ -121,17 +122,33 @@ def test_hang_killed_before_deadline_then_respawn(tmp_path):
 
 def test_stall_killed_by_heartbeat_watchdog(tmp_path):
     """Frozen process: ALL output stops. Killed by missed heartbeats long
-    before the (distant) chunk deadline."""
+    before the (distant) chunk deadline — and the recovery ladder retries
+    in-chunk, so the caller sees a served chunk, not an error."""
     async def main():
         sup = make_supervisor({"chunks": ["stall", "ok"]},
                               tmp_path / "state.json")
         async with await closing(sup):
             t0 = time.monotonic()
-            with pytest.raises(EngineError):
-                await sup.go_multiple(make_chunk(ttl=30.0))
+            responses = await sup.go_multiple(make_chunk(ttl=30.0))
             assert time.monotonic() - t0 < 10.0  # hb_timeout, not deadline
             assert sup.stats.hb_stalls == 1
             assert sup.stats.deadline_kills == 0
+            assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 2
+
+    asyncio.run(main())
+
+
+def test_stall_surfaces_with_replay_disabled(tmp_path):
+    """replay=False restores the pre-round-9 whole-chunk semantics: the
+    first failure surfaces to the caller, the NEXT chunk recovers."""
+    async def main():
+        sup = make_supervisor({"chunks": ["stall", "ok"]},
+                              tmp_path / "state.json", replay=False)
+        async with await closing(sup):
+            with pytest.raises(EngineError):
+                await sup.go_multiple(make_chunk(ttl=30.0))
+            assert sup.stats.hb_stalls == 1
             responses = await sup.go_multiple(make_chunk())
             assert fake_cp(responses) == [FAKE_CP] * 2
 
@@ -143,10 +160,8 @@ def test_crash_respawn_and_recover(tmp_path):
         sup = make_supervisor({"chunks": ["crash:9", "ok"]},
                               tmp_path / "state.json")
         async with await closing(sup):
-            with pytest.raises(EngineError):
-                await sup.go_multiple(make_chunk())
-            assert sup.stats.deaths == 1
             responses = await sup.go_multiple(make_chunk())
+            assert sup.stats.deaths == 1
             assert fake_cp(responses) == [FAKE_CP] * 2
             assert sup.stats.spawns == 2
             # success clears the respawn backoff and the death window
@@ -160,11 +175,9 @@ def test_corrupt_frame_kills_child(tmp_path):
         sup = make_supervisor({"chunks": ["corrupt", "ok"]},
                               tmp_path / "state.json")
         async with await closing(sup):
-            with pytest.raises(EngineError):
-                await sup.go_multiple(make_chunk(ttl=30.0))
+            responses = await sup.go_multiple(make_chunk(ttl=30.0))
             assert sup.stats.protocol_errors >= 1
             assert sup.stats.kills >= 1
-            responses = await sup.go_multiple(make_chunk())
             assert fake_cp(responses) == [FAKE_CP] * 2
 
     asyncio.run(main())
@@ -202,53 +215,59 @@ def test_slow_chunk_survives_on_heartbeats():
 
 def test_boot_stall_killed_then_recovers(tmp_path):
     """Warmup has no deadline (XLA compiles run minutes) but a SILENT
-    warmup is dead — the heartbeat watchdog still applies."""
+    warmup is dead — the heartbeat watchdog still applies, and the ladder
+    respawns in-chunk."""
     async def main():
         sup = make_supervisor({"boot": ["stall", "ready"], "chunks": ["ok"]},
                               tmp_path / "state.json")
         async with await closing(sup):
-            with pytest.raises(EngineError):
-                await sup.go_multiple(make_chunk())
-            assert sup.stats.hb_stalls == 1
             responses = await sup.go_multiple(make_chunk())
+            assert sup.stats.hb_stalls == 1
             assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 2
 
     asyncio.run(main())
 
 
-def test_boot_crash_surfaces_and_recovers(tmp_path):
+def test_boot_crash_recovers_in_chunk(tmp_path):
     async def main():
         sup = make_supervisor({"boot": ["crash:7", "ready"], "chunks": ["ok"]},
                               tmp_path / "state.json")
         async with await closing(sup):
-            with pytest.raises(EngineError):
-                await sup.go_multiple(make_chunk())
             responses = await sup.go_multiple(make_chunk())
             assert fake_cp(responses) == [FAKE_CP] * 2
+            assert sup.stats.spawns == 2
 
     asyncio.run(main())
 
 
 def test_breaker_trips_to_cpu_fallback_and_probe_recovers(tmp_path):
-    """Acceptance path: N consecutive child deaths open the breaker,
-    chunks degrade to the pure-Python CPU engine (responses still
-    produced), and a later successful probe restores the child path."""
+    """Acceptance path: N exhausted recovery ladders open the breaker
+    (one breaker-visible death per given-up ladder — in-ladder deaths
+    stay invisible to the window), chunks degrade to the pure-Python CPU
+    engine (responses still produced), and a later successful probe
+    restores the child path."""
     async def main():
         sup = make_supervisor(
-            {"chunks": ["crash:1", "crash:1", "ok"]},
+            {"chunks": ["crash:1", "crash:1", "crash:1", "crash:1", "ok"]},
             tmp_path / "state.json",
             breaker_threshold=2,
             breaker_window=600.0,
             probe_interval=0.4,
+            bisect_max=1,  # each call: 2 deaths, then the ladder gives up
+            backoff=RandomizedBackoff(max_s=0.05),
         )
         async with await closing(sup):
-            # death 1: plain failure, breaker still closed
+            # ladder 1 exhausts (2 child deaths → ONE breaker-visible
+            # death): plain failure, breaker still closed
             with pytest.raises(EngineError):
                 await sup.go_multiple(make_chunk())
             assert not sup._breaker_open
+            assert sup.stats.deaths == 2
+            assert len(sup._deaths) == 1
 
-            # death 2 trips the breaker; the SAME chunk is salvaged on the
-            # CPU fallback, so responses are still produced
+            # ladder 2 exhausts and trips the breaker; the SAME chunk is
+            # salvaged on the CPU fallback, so responses are still produced
             responses = await sup.go_multiple(make_chunk(ttl=60.0))
             assert sup._breaker_open
             assert sup.stats.breaker_trips == 1
@@ -283,10 +302,13 @@ def test_breaker_trips_to_cpu_fallback_and_probe_recovers(tmp_path):
 def test_failed_probe_stays_on_fallback(tmp_path):
     async def main():
         sup = make_supervisor(
-            {"chunks": ["crash:1", "crash:1", "crash:1", "ok"]},
+            {"chunks": ["crash:1", "crash:1", "crash:1", "crash:1",
+                        "crash:1", "ok"]},
             tmp_path / "state.json",
             breaker_threshold=2,
             probe_interval=0.3,
+            bisect_max=1,
+            backoff=RandomizedBackoff(max_s=0.05),
         )
         async with await closing(sup):
             with pytest.raises(EngineError):
@@ -294,7 +316,8 @@ def test_failed_probe_stays_on_fallback(tmp_path):
             await sup.go_multiple(make_chunk(ttl=60.0))  # trips + salvages
             assert sup._breaker_open
             await asyncio.sleep(0.35)
-            # probe hits crash #3: breaker stays open, chunk still served
+            # probe (single dispatch, no ladder) hits crash #5: breaker
+            # stays open, chunk still served
             responses = await sup.go_multiple(make_chunk(ttl=60.0))
             assert len(responses) == 2
             assert sup._breaker_open

@@ -13,6 +13,13 @@ Examples:
     python -m tools.chaos --script hang --chunk-ttl 3
     python -m tools.chaos --script '{"chunks": ["stall", "ok"]}' --chunks 3
     python -m tools.chaos --list
+    python -m tools.chaos --scenario --format=github   # CI acceptance run
+
+`--scenario` runs the round-9 session-recovery acceptance ladder
+end-to-end (kill-mid-chunk replay, hang-at-segment progress kill,
+crash-on-fingerprint quarantine) and exits non-zero on any lost or
+duplicated PositionResponse, on a full-chunk re-search after a partial
+kill, or on quarantine routing the wrong position.
 """
 from __future__ import annotations
 
@@ -26,7 +33,12 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from fishnet_tpu.client.ipc import Chunk, WorkPosition  # noqa: E402
+from fishnet_tpu.client.backoff import RandomizedBackoff  # noqa: E402
+from fishnet_tpu.client.ipc import (  # noqa: E402
+    Chunk,
+    WorkPosition,
+    position_fingerprint,
+)
 from fishnet_tpu.client.logger import Logger  # noqa: E402
 from fishnet_tpu.client.wire import (  # noqa: E402
     AnalysisWork,
@@ -34,7 +46,7 @@ from fishnet_tpu.client.wire import (  # noqa: E402
     NodeLimit,
 )
 from fishnet_tpu.engine.base import EngineError  # noqa: E402
-from fishnet_tpu.engine.fakehost import NAMED_SCRIPTS  # noqa: E402
+from fishnet_tpu.engine.fakehost import FAKE_CP, NAMED_SCRIPTS  # noqa: E402
 from fishnet_tpu.engine.supervisor import SupervisedEngine  # noqa: E402
 
 START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
@@ -98,7 +110,12 @@ async def replay(args) -> int:
     finally:
         await sup.close()
         Path(state.name).unlink(missing_ok=True)
-    s = sup.stats
+    print_stats(sup.stats)
+    print(f"chunks: {args.chunks - failures} served, {failures} failed")
+    return 0
+
+
+def print_stats(s) -> None:
     print(
         f"\nstats: spawns={s.spawns} deaths={s.deaths} kills={s.kills} "
         f"hb_stalls={s.hb_stalls} deadline_kills={s.deadline_kills} "
@@ -106,7 +123,155 @@ async def replay(args) -> int:
         f"breaker_resets={s.breaker_resets} probes={s.probes} "
         f"fallback_chunks={s.fallback_chunks} chunks_ok={s.chunks_ok}"
     )
-    print(f"chunks: {args.chunks - failures} served, {failures} failed")
+    print(
+        f"recovery: partials={s.partials} "
+        f"duplicate_partials={s.duplicate_partials} replays={s.replays} "
+        f"replayed_positions={s.replayed_positions} "
+        f"bisections={s.bisections} quarantined={s.quarantined} "
+        f"quarantine_routed={s.quarantine_routed} "
+        f"progress_stalls={s.progress_stalls}"
+    )
+
+
+# ------------------------------------------------ scripted acceptance run
+
+
+def _scenario_supervisor(script: str, state_name: str, **kw):
+    host_cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", script,
+        "--state", state_name,
+        "--hb-interval", "0.05",
+    ]
+    kw.setdefault("hb_interval", 0.05)
+    kw.setdefault("hb_timeout", 1.0)
+    kw.setdefault("backoff", RandomizedBackoff(max_s=0.05))
+    kw.setdefault("logger", Logger(verbose=0))
+    return SupervisedEngine(host_cmd, **kw)
+
+
+def _check_exactly_once(responses, n, problems, phase) -> None:
+    indices = [r.position_index for r in responses]
+    if sorted(indices) != list(range(n)):
+        problems.append(
+            f"{phase}: lost/duplicated PositionResponse — indices {indices}"
+        )
+
+
+async def scenario(args) -> int:
+    """The round-9 acceptance ladder, one phase per rung."""
+    problems = []
+    n = 4
+    with tempfile.TemporaryDirectory(prefix="chaos-scenario-") as tmp:
+        # ---- phase 1: kill-mid-chunk — replay resumes the suffix
+        print("== phase 1: kill after 2 partials (replay) ==")
+        sup = _scenario_supervisor(
+            json.dumps({"chunks": ["die-after:2", "partial-ok"]}),
+            f"{tmp}/s1.json",
+        )
+        try:
+            responses = await sup.go_multiple(make_chunk(1, 30.0, n))
+            _check_exactly_once(responses, n, problems, "kill-mid-chunk")
+            re_searched = n - sup.stats.replayed_positions
+            if not (0 < re_searched < n):
+                problems.append(
+                    "kill-mid-chunk: expected strictly fewer re-searched "
+                    f"positions than chunk size, got {re_searched} of {n} "
+                    f"(replayed={sup.stats.replayed_positions})"
+                )
+        except EngineError as e:
+            problems.append(f"kill-mid-chunk: chunk failed outright: {e}")
+        finally:
+            print_stats(sup.stats)
+            await sup.close()
+
+        # ---- phase 2: hang-at-segment — progress watchdog + replay
+        print("\n== phase 2: hang after 1 partial (progress stall) ==")
+        sup = _scenario_supervisor(
+            json.dumps({"chunks": ["hang-at:1", "partial-ok"]}),
+            f"{tmp}/s2.json",
+            progress_timeout=0.5,
+        )
+        try:
+            responses = await sup.go_multiple(make_chunk(2, 30.0, n))
+            _check_exactly_once(responses, n, problems, "hang-at-segment")
+            if sup.stats.progress_stalls < 1:
+                problems.append(
+                    "hang-at-segment: the stalled partial stream was not "
+                    "killed by progress_timeout"
+                )
+            if sup.stats.deadline_kills:
+                problems.append(
+                    "hang-at-segment: hit the chunk deadline instead of "
+                    "the progress watchdog"
+                )
+        except EngineError as e:
+            problems.append(f"hang-at-segment: chunk failed outright: {e}")
+        finally:
+            print_stats(sup.stats)
+            await sup.close()
+
+        # ---- phase 3: crash-on-fingerprint — quarantine exactly the poison
+        print("\n== phase 3: crash on one fingerprint (quarantine) ==")
+        ref = _scenario_supervisor(
+            json.dumps({"chunks": ["partial-ok"]}), f"{tmp}/ref.json"
+        )
+        try:
+            fault_free = await ref.go_multiple(make_chunk(3, 30.0, n))
+        finally:
+            await ref.close()
+        chunk = make_chunk(3, 60.0, n)
+        poison_index = 2
+        poison = position_fingerprint(chunk.positions[poison_index])
+        sup = _scenario_supervisor(
+            json.dumps({"chunks": [f"crash-on-fp:{poison}"]}),
+            f"{tmp}/s3.json",
+        )
+        try:
+            responses = await sup.go_multiple(chunk)
+            _check_exactly_once(responses, n, problems, "crash-on-fp")
+            if sup.stats.quarantined != 1:
+                problems.append(
+                    f"crash-on-fp: quarantined={sup.stats.quarantined}, "
+                    "expected exactly the one poison position"
+                )
+            for i, (got, want) in enumerate(zip(responses, fault_free)):
+                got_cp = got.scores.best().value
+                if i == poison_index:
+                    if got_cp == FAKE_CP:
+                        problems.append(
+                            "crash-on-fp: poison position answered by the "
+                            "engine path, not the CPU fallback"
+                        )
+                elif (got_cp, got.best_move, got.depth, got.nodes) != (
+                    want.scores.best().value, want.best_move,
+                    want.depth, want.nodes,
+                ):
+                    problems.append(
+                        f"crash-on-fp: position {i} not bit-identical to "
+                        "the fault-free run"
+                    )
+            if sup.stats.breaker_trips:
+                problems.append(
+                    "crash-on-fp: the recovery ladder tripped the "
+                    "whole-engine breaker"
+                )
+        except EngineError as e:
+            problems.append(f"crash-on-fp: chunk failed outright: {e}")
+        finally:
+            print_stats(sup.stats)
+            await sup.close()
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos scenario::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos scenario: all phases passed "
+          "(replay, progress-stall, quarantine)")
     return 0
 
 
@@ -132,11 +297,18 @@ def main(argv=None) -> int:
     p.add_argument("--hb-timeout", type=float, default=2.0)
     p.add_argument("--breaker-threshold", type=int, default=3)
     p.add_argument("--probe-interval", type=float, default=5.0)
+    p.add_argument("--scenario", action="store_true",
+                   help="run the session-recovery acceptance ladder and "
+                        "exit non-zero on any delivery violation")
+    p.add_argument("--format", choices=["text", "github"], default="text",
+                   help="github emits ::error annotations for CI")
     args = p.parse_args(argv)
     if args.list:
         for name, script in NAMED_SCRIPTS.items():
-            print(f"{name:12s} {json.dumps(script)}")
+            print(f"{name:14s} {json.dumps(script)}")
         return 0
+    if args.scenario:
+        return asyncio.run(scenario(args))
     return asyncio.run(replay(args))
 
 

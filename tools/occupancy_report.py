@@ -27,6 +27,14 @@ bound — the pipeline exists precisely to keep it small. --pipeline-ab
 runs the stage twice (FISHNET_TPU_PIPELINE off, then on) and FAILS on
 any per-position result divergence: the pipelined loop must be
 bit-identical to the round-7 synchronous loop.
+
+Round 9 (session recovery): --stats-db PATH reads the client's sqlite
+stats store and prepends the latest SupervisorStats snapshot (replay /
+bisection / quarantine counters, exported by the client's summary loop)
+plus the persisted quarantine list — one line per poison fingerprint.
+--stats-only prints that report and exits without importing JAX or
+running the occupancy stage, so it works on a machine with no
+accelerator at all.
 """
 from __future__ import annotations
 
@@ -37,6 +45,52 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _recovery_report(db_path: str, emit_json: bool) -> int:
+    """Print the latest persisted SupervisorStats + quarantine list."""
+    import sqlite3
+
+    if not os.path.exists(db_path):
+        print(f"recovery: no stats db at {db_path}")
+        return 1
+    con = sqlite3.connect(db_path)
+    try:
+        try:
+            row = con.execute(
+                "SELECT timestamp, counters FROM supervisor_stats "
+                "ORDER BY id DESC LIMIT 1"
+            ).fetchone()
+            quarantine = con.execute(
+                "SELECT timestamp, fingerprint, batch_id, position_index "
+                "FROM supervisor_quarantine ORDER BY id"
+            ).fetchall()
+        except sqlite3.Error as e:
+            print(f"recovery: stats db has no supervisor tables ({e})")
+            return 1
+    finally:
+        con.close()
+
+    if row is None:
+        print("recovery: no SupervisorStats snapshot recorded yet")
+        counters = {}
+    else:
+        counters = json.loads(row[1])
+        print(f"recovery: SupervisorStats at {row[0]}")
+        for key in sorted(counters):
+            print(f"  {key:>20} {counters[key]}")
+    print(f"quarantine: {len(quarantine)} poison position(s)")
+    for ts, fp, batch, idx in quarantine:
+        print(f"  {fp}  batch={batch} index={idx}  at {ts}")
+    if emit_json:
+        print("RECOVERY " + json.dumps({
+            "counters": counters,
+            "quarantine": [
+                {"fingerprint": fp, "batch_id": batch, "position_index": idx}
+                for _, fp, batch, idx in quarantine
+            ],
+        }))
+    return 0
 
 
 def _boards(lanes: int, variant: str, cap: int | None = None):
@@ -85,7 +139,18 @@ def main() -> int:
                     help="print a machine-readable summary line")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU shape for CI (8 lanes, depth 2, toy net)")
+    ap.add_argument("--stats-db", default=None, metavar="PATH",
+                    help="prepend the latest SupervisorStats snapshot and "
+                         "quarantine list from this client stats sqlite db")
+    ap.add_argument("--stats-only", action="store_true",
+                    help="with --stats-db: print the recovery report and "
+                         "exit without running the occupancy stage")
     args = ap.parse_args()
+
+    if args.stats_db is not None:
+        rc = _recovery_report(args.stats_db, args.json)
+        if args.stats_only:
+            return rc
 
     if args.smoke:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
