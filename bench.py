@@ -145,14 +145,19 @@ def _all_boards_for(B: int, variant: str, fen_set: str):
 def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
                   variant: str, fen_set: str, max_ply: int, tt,
                   stream: bool, mode: str, platform: str,
-                  tt_log2: int, bench_dtype: str) -> None:
+                  tt_log2: int, bench_dtype: str, mesh=None) -> None:
     """Refill A/B stage (ISSUE 4): positions_done_per_s over the SAME
     N-position workload at the SAME width B — chunk-serial width-B
     batches drained one after another (stream=False, the
     `_go_multiple_locked` regime) vs one full-width program whose DONE
     lanes are respliced with queued positions at segment boundaries
     (stream=True, ops/search.py search_stream). Occupancy counters land
-    in the RESULT JSON either way."""
+    in the RESULT JSON either way.
+
+    mesh (BENCH_MESH, round 10): both passes run sharded over the mesh
+    devices — serial through search_batch_resumable(mesh=...), streamed
+    through search_stream(mesh=...) with shard-local refill — and the
+    stream summary grows per-shard mean live fractions."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -183,7 +188,7 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
                 params, batch,
                 d_arr.astype(np.int32), b_arr.astype(np.int32),
                 max_ply=max_ply, segment_steps=seg, tt=tt,
-                variant=variant,
+                variant=variant, mesh=mesh,
             )
             tt = out.pop("tt")
             jax.block_until_ready(out["nodes"])
@@ -195,6 +200,7 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
         out = S.search_stream(
             params, roots, depth_all, budget_all, max_ply=max_ply,
             width=B, segment_steps=seg, tt=tt, variant=variant,
+            mesh=mesh,
         )
         jax.block_until_ready(out["nodes"])
         done = int(np.asarray(out["done"]).sum())
@@ -218,6 +224,19 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
             "transfers": sum(o["transfers"] for o in occ),
             "pipeline": int(settings.get_bool("FISHNET_TPU_PIPELINE")),
         }
+        if mesh is not None:
+            # per-shard mean live fraction (shard_live columns from
+            # search_stream's mesh occupancy rows): imbalance here means
+            # the most-free-shard admission policy is not keeping up
+            ndev = mesh.devices.size
+            local = B // ndev
+            denom = sum(o["steps"] * local for o in occ) or 1
+            summary["ndev"] = ndev
+            summary["shard_mean_live"] = [
+                round(sum(o["steps"] * o["shard_live"][s] for o in occ)
+                      / denom, 4)
+                for s in range(ndev)
+            ]
         return done, nodes, out["tt"], summary
 
     run = stream_pass if stream else serial_pass
@@ -246,6 +265,7 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
             "positions_done": done,
             "positions_done_per_s": round(done / dt, 1),
             "refill": "stream" if stream else "serial",
+            "mesh": 0 if mesh is None else int(mesh.devices.size),
             "occupancy": occ,
             "net": os.environ.get("BENCH_NET", "random"),
             "dtype": bench_dtype or "f32",
@@ -343,14 +363,36 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     prefer_deep = helpers > 1
     tt_gen = 1 if helpers > 1 else 0
 
+    # BENCH_MESH set → the refill A/B stage runs sharded over every local
+    # device (shard-local refill, stacked boundary summaries); B must
+    # divide over the devices. Only meaningful with BENCH_REFILL — the
+    # lockstep single-batch stage below stays single-device
+    mesh = None
+    if os.environ.get("BENCH_MESH", "") not in ("", "0", "false", "no"):
+        from fishnet_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        if B % mesh.devices.size:
+            raise RuntimeError(
+                f"BENCH_MESH: width {B} must divide over "
+                f"{mesh.devices.size} devices")
+        _hb(t0, f"mesh: {mesh.devices.size} devices")
+
     # optional shared transposition table (BENCH_TT_LOG2=21 etc.); off by
-    # default so the metric stays a raw search-throughput number
+    # default so the metric stays a raw search-throughput number. Mesh
+    # stages take the per-device sharded table instead (each device
+    # hashes into its private shard)
     tt = None
     tt_log2 = int(os.environ.get("BENCH_TT_LOG2", "0"))
     if tt_log2:
-        from fishnet_tpu.ops import tt as tt_mod
+        if mesh is not None:
+            from fishnet_tpu.parallel.mesh import make_sharded_table
 
-        tt = tt_mod.make_table(tt_log2)
+            tt = make_sharded_table(mesh, tt_log2)
+        else:
+            from fishnet_tpu.ops import tt as tt_mod
+
+            tt = tt_mod.make_table(tt_log2)
 
     # BENCH_REFILL set → the refill A/B stage instead of the lockstep
     # single-batch stage: same width, same workload (the FULL multipv
@@ -360,8 +402,11 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     if refill_env != "":
         _bench_refill(t0, params, B, depth, budget, variant, fen_set,
                       max_ply, tt, refill_env not in ("0", "false", "no"),
-                      mode, platform, tt_log2, bench_dtype)
+                      mode, platform, tt_log2, bench_dtype, mesh=mesh)
         return
+    if mesh is not None:
+        raise RuntimeError("BENCH_MESH requires BENCH_REFILL (the A/B "
+                           "stage); the lockstep stage is single-device")
     _hb(t0, "inputs built")
 
     # compile each program explicitly so a compiler hang is distinguishable
@@ -650,6 +695,28 @@ def main() -> None:
              {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
               "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1",
               "FISHNET_TPU_PIPELINE": "1"}),
+            # mesh parity A/B (round 10): the production refill workload
+            # sharded over 8 devices (XLA_FLAGS forces 8 virtual CPU
+            # devices when no real mesh is present; on a TPU pod slice
+            # the flag is inert and the real chips shard). _mesh_serial
+            # drains chunk-serial width-192 sharded batches; _mesh_refill
+            # streams with shard-local refill (parallel/mesh.py). The
+            # refill row's occupancy summary carries per-shard mean live
+            # fractions and the boundary transfer count — acceptance is
+            # refill mean_live_frac strictly above serial at the same
+            # width, with transfers = 1 on no-finish boundaries
+            ("production_d6_mp32_mesh_serial", 192, 6, "standard",
+             "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "0",
+              "BENCH_MESH": "1",
+              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
+            ("production_d6_mp32_mesh_refill", 192, 6, "standard",
+             "multipv",
+             {"BENCH_MAX_PLY": "32", "BENCH_NET": "default",
+              "BENCH_TT_LOG2": "21", "BENCH_REFILL": "1",
+              "BENCH_MESH": "1",
+              "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
             # same production shape with 3 Lazy-SMP helper lanes riding
             # each of the 192 primaries (768 lanes total, shared 2M-slot
             # TT): the round-6 acceptance comparison is this row's

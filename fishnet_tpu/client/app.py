@@ -70,6 +70,7 @@ def make_engine_factory(cfg: Config, logger: Logger, stats=None):
                         max_depth=cfg.tpu_depth,
                         helper_lanes=cfg.tpu_helpers,
                         refill=cfg.tpu_refill,
+                        mesh_refill=cfg.tpu_mesh_refill,
                         logger=logger,
                         replay=cfg.tpu_replay,
                         bisect_max=cfg.tpu_bisect_max,
@@ -84,6 +85,7 @@ def make_engine_factory(cfg: Config, logger: Logger, stats=None):
                         max_depth=cfg.tpu_depth,
                         helper_lanes=cfg.tpu_helpers,
                         refill=cfg.tpu_refill,
+                        mesh_refill=cfg.tpu_mesh_refill,
                         logger=logger,
                     )
             # one device program (or supervised child) shared by all

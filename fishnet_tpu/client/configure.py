@@ -101,6 +101,11 @@ class Config:
     # instead of narrowing and draining chunks serially; --no-tpu-refill
     # restores strict chunk-serial dispatch
     tpu_refill: bool = True
+    # shard-aware refill on multi-chip hosts (parallel/mesh.py sharded
+    # segment/refill callables driven by the same LaneScheduler);
+    # --no-tpu-mesh-refill pins meshed engines back to chunk-serial
+    # dispatch without touching single-device refill
+    tpu_mesh_refill: bool = True
     # host the TPU engine in a supervised child process (engine/supervisor.py)
     # so a wedged device can be hard-killed and respawned; --no-supervisor
     # reverts to the in-process engine (debugging, single-process profiling)
@@ -158,6 +163,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-tpu-refill", action="store_true",
                    help="disable continuous lane refill (strict "
                         "chunk-serial engine dispatch)")
+    p.add_argument("--no-tpu-mesh-refill", action="store_true",
+                   help="disable shard-aware lane refill on multi-chip "
+                        "hosts (meshed engines fall back to chunk-serial "
+                        "dispatch; single-device refill is unaffected)")
     p.add_argument("--no-supervisor", action="store_true",
                    help="run the TPU engine in-process instead of in a "
                         "supervised child process")
@@ -236,6 +245,11 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     refill_ini = str(ini.get("tpu_refill", "")).strip().lower()
     cfg.tpu_refill = not (
         args.no_tpu_refill or refill_ini in ("0", "false", "no", "off")
+    )
+    mesh_refill_ini = str(ini.get("tpu_mesh_refill", "")).strip().lower()
+    cfg.tpu_mesh_refill = not (
+        args.no_tpu_mesh_refill
+        or mesh_refill_ini in ("0", "false", "no", "off")
     )
     supervisor_ini = str(ini.get("supervisor", "")).strip().lower()
     cfg.supervisor = not (

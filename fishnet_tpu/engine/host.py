@@ -58,6 +58,8 @@ def _build_engine(args, log):
         max_depth=args.depth or 12,
         helper_lanes=args.helpers,
         refill=None if args.refill is None else bool(args.refill),
+        mesh_refill=(None if args.mesh_refill is None
+                     else bool(args.mesh_refill)),
     )
     if not args.skip_warmup:
         engine.warmup(None, log)
@@ -81,6 +83,10 @@ def main(argv=None) -> int:
     # continuous lane refill (engine/tpu.py LaneScheduler); None defers
     # to FISHNET_TPU_REFILL / the engine default, 0 disables
     p.add_argument("--refill", type=int, default=None)
+    # shard-aware refill on multi-chip hosts (parallel/mesh.py sharded
+    # callables); None defers to FISHNET_TPU_MESH_REFILL, 0 pins meshed
+    # engines back to chunk-serial dispatch
+    p.add_argument("--mesh-refill", type=int, default=None)
     # stream per-position `partial` frames for the supervisor's session
     # journal (engine/supervisor.py recovery ladder); 0 disables
     p.add_argument("--partials", type=int, default=1)

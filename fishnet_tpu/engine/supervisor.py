@@ -81,6 +81,7 @@ def default_host_cmd(
     hb_interval: float = 1.0,
     helpers: Optional[int] = None,
     refill: Optional[bool] = None,
+    mesh_refill: Optional[bool] = None,
     partials: Optional[bool] = None,
 ) -> List[str]:
     cmd = [
@@ -97,6 +98,10 @@ def default_host_cmd(
     if refill is not None:
         # continuous lane refill (engine/tpu.py LaneScheduler); 0 disables
         cmd += ["--refill", "1" if refill else "0"]
+    if mesh_refill is not None:
+        # shard-aware refill on multi-chip hosts; 0 pins meshed engines
+        # back to chunk-serial dispatch (FISHNET_TPU_MESH_REFILL)
+        cmd += ["--mesh-refill", "1" if mesh_refill else "0"]
     if partials is not None:
         # incremental per-position result streaming for the supervisor's
         # session journal (engine/host.py partial frames); 0 disables
@@ -158,6 +163,7 @@ class SupervisedEngine:
         max_depth: Optional[int] = None,
         helper_lanes: Optional[int] = None,
         refill: Optional[bool] = None,
+        mesh_refill: Optional[bool] = None,
         logger: Optional[Logger] = None,
         hb_interval: float = 1.0,
         hb_timeout: Optional[float] = None,
@@ -195,7 +201,7 @@ class SupervisedEngine:
         self.host_cmd = host_cmd or default_host_cmd(
             backend=backend, weights=weights_path, depth=max_depth,
             hb_interval=hb_interval, helpers=helper_lanes, refill=refill,
-            partials=self.replay,
+            mesh_refill=mesh_refill, partials=self.replay,
         )
         self.logger = logger or Logger()
         self.hb_interval = hb_interval

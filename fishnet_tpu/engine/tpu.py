@@ -143,6 +143,7 @@ class TpuEngine:
         max_lanes: Optional[int] = None,  # single-dispatch lane ceiling
         helper_lanes: Optional[int] = None,  # Lazy-SMP lanes per position (K)
         refill: Optional[bool] = None,  # continuous lane refill (LaneScheduler)
+        mesh_refill: Optional[bool] = None,  # refill on mesh hosts too
         logger=None,  # client Logger for operational warnings; stderr if None
     ) -> None:
         from ..utils import enable_compile_cache
@@ -250,14 +251,21 @@ class TpuEngine:
         # chunks flow through the LaneScheduler, which keeps one
         # full-width compiled step busy by splicing queued positions
         # into DONE lanes at segment boundaries instead of narrowing
-        # and draining chunks serially. Mesh-sharded lanes are not
-        # host-addressable per shard, so the scheduler only engages on
-        # single-device hosts (_go_multiple_sync checks at dispatch
-        # time); everything else takes the strict chunk-serial path,
-        # which stays bit-identical to the pre-refill engine.
+        # and draining chunks serially. On mesh hosts the scheduler
+        # drives the shard_map'd segment/refill callables
+        # (parallel/mesh.py): each device resplices ITS lanes locally
+        # and the boundary is one stacked-summary fetch, so the same
+        # occupancy win extends across chips. FISHNET_TPU_MESH_REFILL=0
+        # pins meshed engines back to strict chunk-serial dispatch
+        # (single-device hosts ignore it); everything else — move jobs,
+        # multipv, refill off — takes the chunk-serial path, which
+        # stays bit-identical to the pre-refill engine.
         if refill is None:
             refill = settings.get_bool("FISHNET_TPU_REFILL")
         self.refill = bool(refill)
+        if mesh_refill is None:
+            mesh_refill = settings.get_bool("FISHNET_TPU_MESH_REFILL")
+        self.mesh_refill = bool(mesh_refill)
         self._scheduler = LaneScheduler(self)
         # per-segment occupancy accounting (live/helper/idle lane
         # counts, refill events), surfaced into bench rows and logs
@@ -730,14 +738,16 @@ class TpuEngine:
 
     def _go_multiple_sync(self, chunk: Chunk) -> List[PositionResponse]:
         # single-pv analysis chunks flow through the occupancy-driven
-        # LaneScheduler when refill is on (and lanes are host-addressable,
-        # i.e. no mesh); every other shape takes the strict chunk-serial
-        # path UNCHANGED — with refill off the engine is bit-identical to
-        # the pre-refill code by construction (enforced by tests).
+        # LaneScheduler when refill is on — on mesh hosts too, via the
+        # sharded segment/refill callables (FISHNET_TPU_MESH_REFILL=0
+        # opts a meshed engine out); every other shape takes the strict
+        # chunk-serial path UNCHANGED — with refill off the engine is
+        # bit-identical to the pre-refill code by construction
+        # (enforced by tests).
         work = chunk.work
         if (
             self.refill
-            and self.mesh is None
+            and (self.mesh is None or self.mesh_refill)
             and isinstance(work, AnalysisWork)
             and work.effective_multipv() == 1
         ):
@@ -1496,6 +1506,14 @@ class LaneScheduler:
             ).board
         K = eng.helper_lanes
         B = eng._helper_width(min(max(n_hint, 1), eng.max_lanes))
+        # shard-aware session: under a mesh the SAME loop drives the
+        # shard_map'd segment/refill callables (parallel/mesh.py) — B is
+        # padded to a multiple of n_dev by _helper_width, each device
+        # owns `local` consecutive lanes, and every boundary is one
+        # stacked-summary fetch
+        mesh = eng.mesh
+        n_shard = eng.n_dev if mesh is not None else 1
+        local = B // n_shard
         seg = settings.get_segment()
         ctrl = None
         if seg is None:  # FISHNET_TPU_SEGMENT=auto
@@ -1535,6 +1553,17 @@ class LaneScheduler:
             order_jitter=jnp.zeros((B,), jnp.int32),
             group=jnp.zeros((B,), jnp.int32),
         )
+        if mesh is not None:
+            from ..parallel.mesh import (
+                refill_lanes_sharded,
+                run_segment_sharded,
+                shard_batch,
+            )
+
+            # place the base state sharded before the first dispatch:
+            # the sharded segment donates its operands, and donation
+            # only takes when the input already carries the sharding
+            state = shard_batch(mesh, state)
         tt = eng.tt
 
         # admissions accumulated between boundaries, flushed as ONE
@@ -1692,13 +1721,52 @@ class LaneScheduler:
             with self._q_lock:
                 return len(self._pending)
 
-        def dispatch(st, table, n_steps):
-            # donates st and table (ops/search.py): both handles are
-            # dead after this call — always rebind to the outputs
-            return search_ops._run_segment_jit(
-                eng.params, st, table, n_steps, variant, False,
-                prefer_deep, jnp.asarray(gen),
-            )
+        if mesh is not None:
+            def dispatch(st, table, n_steps):
+                # donates st and table (parallel/mesh.py): both handles
+                # are dead after this call — always rebind to the
+                # outputs. Each device advances its shard locally; the
+                # summary arrives stacked (n_shard, local+1, 4).
+                return run_segment_sharded(
+                    mesh, eng.params, st, table, n_steps,
+                    variant=variant, prefer_deep=prefer_deep,
+                    tt_gen=jnp.asarray(gen),
+                )
+        else:
+            def dispatch(st, table, n_steps):
+                # donates st and table (ops/search.py): both handles are
+                # dead after this call — always rebind to the outputs
+                return search_ops._run_segment_jit(
+                    eng.params, st, table, n_steps, variant, False,
+                    prefer_deep, jnp.asarray(gen),
+                )
+
+        def canon_summ(raw):
+            """Boundary summary → ((B, 4) lane rows, step count,
+            per-shard step list). Single-device summaries are (B+1, 4);
+            sharded ones come back stacked (n_shard, local+1, 4) and
+            the step count is the max over shards (devices park
+            independently)."""
+            if mesh is None:
+                return raw[:B], int(raw[B, search_ops.SUM_DONE]), None
+            lanes = raw[:, :local, :].reshape(B, search_ops.SUM_W)
+            shard_steps = [
+                int(x) for x in raw[:, local, search_ops.SUM_DONE]
+            ]
+            return lanes, max(shard_steps), shard_steps
+
+        def shard_occup():
+            """Busy (primary or helper) lane count per shard, or None
+            off-mesh — the per-shard occupancy column of the log."""
+            if mesh is None:
+                return None
+            return [
+                sum(
+                    1 for i in range(s * local, (s + 1) * local)
+                    if lane_job[i] is not None or lane_owner[i] is not None
+                )
+                for s in range(n_shard)
+            ]
 
         def on_primary_parked(job: _RefillJob, lane: int, score: int,
                               move: int, nodes: int, nodes_row,
@@ -1806,17 +1874,32 @@ class LaneScheduler:
                         self._finalize(job, now)
 
         def admit_new(now: float):
-            # ---- admit pending positions, earliest deadline first
-            free = [
-                i for i in range(B)
-                if lane_job[i] is None and lane_owner[i] is None
-            ]
+            # ---- admit pending positions, earliest deadline first.
+            # Free lanes are tracked per shard and every admission lands
+            # on the shard with the most free lanes (ties → lowest
+            # shard), hardest-deadline-first within the boundary, so
+            # queued positions spread across devices instead of piling
+            # onto shard 0's early lanes. With one shard this is exactly
+            # the historical ascending-lane assignment (one list, front
+            # pops) — the single-device bit-identity contract holds.
+            free_by_shard: List[List[int]] = [[] for _ in range(n_shard)]
+            for i in range(B):
+                if lane_job[i] is None and lane_owner[i] is None:
+                    free_by_shard[i // local].append(i)
+            n_free = sum(len(f) for f in free_by_shard)
+
+            def take_lane() -> int:
+                s = max(
+                    range(n_shard), key=lambda i: len(free_by_shard[i])
+                )
+                return free_by_shard[s].pop(0)
+
             if not entry.event.is_set():
                 with self._q_lock:
                     self._pending.sort(key=lambda j: j.deadline)
                     take: List[_RefillJob] = []
                     for j in list(self._pending):
-                        if len(take) >= len(free):
+                        if len(take) >= n_free:
                             break
                         if j.variant != variant:
                             continue
@@ -1830,10 +1913,11 @@ class LaneScheduler:
                                   "depth 1 completed",
                         )
                         continue
-                    admit_primary(job, free.pop(0))
+                    admit_primary(job, take_lane())
+                    n_free -= 1
                     active.append(job)
             # ---- spend leftover free lanes on Lazy-SMP helpers
-            if K > 1 and tt is not None and free and active:
+            if K > 1 and tt is not None and n_free and active:
                 n_act = len(active)
                 cur = sum(len(j.helpers) for j in active)
                 hardness = [
@@ -1841,28 +1925,40 @@ class LaneScheduler:
                     for j in active
                 ]
                 plan = TpuEngine._plan_helpers(
-                    n_act, n_act + cur + len(free), K, hardness
+                    n_act, n_act + cur + n_free, K, hardness
                 )
                 want: dict = {}
                 for r, _h in plan:
                     want[r] = want.get(r, 0) + 1
                 for r, job in enumerate(active):
-                    while free and len(job.helpers) < want.get(r, 0):
+                    while n_free and len(job.helpers) < want.get(r, 0):
                         admit_helper(
-                            job, free.pop(0), len(job.helpers) + 1
+                            job, take_lane(), len(job.helpers) + 1
                         )
+                        n_free -= 1
 
         def flush_adm(st):
             # ---- flush staged admissions in ONE refill splice (donates
-            # st — rebind to the return value)
+            # st — rebind to the return value); under a mesh the splice
+            # runs through the shard_map'd masked merge, each device
+            # rewriting only its own lanes. Returns (state, count,
+            # per-shard admission counts or None).
             n_adm = len(adm["lane"])
             if not n_adm:
-                return st, 0
-            st = search_ops.refill_lanes(
+                return st, 0, None
+            adm_shard = (
+                None if mesh is None else np.bincount(
+                    np.asarray(adm["lane"], np.int64) // local,
+                    minlength=n_shard,
+                ).astype(int).tolist()
+            )
+            splice_args = (
                 eng.params, st, stack_boards(adm["board"]),
                 adm["lane"],
                 np.asarray(adm["depth"], np.int32),
                 np.asarray(adm["budget"], np.int32),
+            )
+            splice_kw = dict(
                 variant=variant,
                 hist_hash=np.stack(adm["hh"]),
                 hist_halfmove=np.stack(adm["hm"]),
@@ -1871,9 +1967,13 @@ class LaneScheduler:
                 order_jitter=np.asarray(adm["jitter"], np.int32),
                 group=np.asarray(adm["group"], np.int32),
             )
+            if mesh is not None:
+                st = refill_lanes_sharded(mesh, *splice_args, **splice_kw)
+            else:
+                st = search_ops.refill_lanes(*splice_args, **splice_kw)
             for k in adm:
                 adm[k].clear()
-            return st, n_adm
+            return st, n_adm, adm_shard
 
         res: Optional[dict] = None
         try:
@@ -1888,16 +1988,20 @@ class LaneScheduler:
                         now, res["nodes"] if res is not None else None
                     )
                     admit_new(now)
-                    state, n_adm = flush_adm(state)
+                    state, n_adm, adm_shard = flush_adm(state)
                     if not active:
                         break  # nothing running; next session continues
                     # ---- dispatch one segment and block on it
                     live_n = len(active)
                     helper_n = sum(len(j.helpers) for j in active)
+                    shard_live = shard_occup()
                     disp_steps = seg
                     t0 = time.monotonic()
                     state, tt, n, _summ = dispatch(state, tt, seg)
-                    n = int(stats.fetch(n, "steps"))
+                    n_arr = np.asarray(
+                        stats.fetch(n, "steps")
+                    ).reshape(-1)
+                    n = int(n_arr.max())
                     wall = time.monotonic() - t0
                     q_len = q_len_locked()
                     # ---- process finished lanes at the boundary
@@ -1934,6 +2038,12 @@ class LaneScheduler:
                         B, n, live_n, helper_n, n_adm, q_len, wall,
                         snap["host_ms"], snap["device_ms"],
                         snap["transfers"],
+                        shard=None if mesh is None else {
+                            "shard_live": shard_live,
+                            "shard_refilled":
+                                adm_shard or [0] * n_shard,
+                            "shard_steps": [int(x) for x in n_arr],
+                        },
                     )
                     if ctrl is not None:
                         seg = ctrl.update(
@@ -1950,13 +2060,14 @@ class LaneScheduler:
                 now = time.monotonic()
                 reap_jobs(now, None)
                 admit_new(now)
-                state, n_adm = flush_adm(state)
+                state, n_adm, adm_shard = flush_adm(state)
                 pend = None
                 if active:
                     pend_meta = (
                         len(active),
                         sum(len(j.helpers) for j in active),
                         n_adm, q_len_locked(),
+                        shard_occup(), adm_shard,
                     )
                     pend_steps = seg
                     pend = dispatch(state, tt, seg)
@@ -1977,14 +2088,16 @@ class LaneScheduler:
                         nxt_meta = (
                             len(active),
                             sum(len(j.helpers) for j in active), 0, 0,
+                            shard_occup(), None,
                         )
                         nxt_steps = seg
                         nxt = dispatch(p_state, p_tt, seg)
                         tt = nxt[1]
-                    summ = stats.fetch(p_summ, "summary")
-                    n = int(summ[B, search_ops.SUM_DONE])
-                    lane_done = summ[:B, search_ops.SUM_DONE].astype(bool)
-                    nodes_row = summ[:B, search_ops.SUM_NODES]
+                    summ, n, shard_steps = canon_summ(
+                        stats.fetch(p_summ, "summary")
+                    )
+                    lane_done = summ[:, search_ops.SUM_DONE].astype(bool)
+                    nodes_row = summ[:, search_ops.SUM_NODES]
                     # lanes whose park was already handled at an earlier
                     # speculative boundary (admission staged, splice
                     # still pending) report DONE again — skip them
@@ -2026,6 +2139,12 @@ class LaneScheduler:
                         (snap["host_ms"] + snap["device_ms"]) / 1000.0,
                         snap["host_ms"], snap["device_ms"],
                         snap["transfers"],
+                        shard=None if mesh is None else {
+                            "shard_live": pend_meta[4],
+                            "shard_refilled":
+                                pend_meta[5] or [0] * n_shard,
+                            "shard_steps": shard_steps,
+                        },
                     )
                     if ctrl is not None:
                         seg = ctrl.update(
@@ -2037,13 +2156,14 @@ class LaneScheduler:
                         pend_meta = nxt_meta
                         pend_steps = nxt_steps
                         continue
-                    state, n_adm = flush_adm(p_state)
+                    state, n_adm, adm_shard = flush_adm(p_state)
                     if not active:
                         break  # next session handles the rest
                     pend_meta = (
                         len(active),
                         sum(len(j.helpers) for j in active),
                         n_adm, q_len_locked(),
+                        shard_occup(), adm_shard,
                     )
                     pend_steps = seg
                     pend = dispatch(state, tt, seg)
@@ -2068,10 +2188,21 @@ class LaneScheduler:
 
     def _record_occupancy(self, width, steps, live, helpers, refilled,
                           queue, wall, host_ms=0.0, device_ms=0.0,
-                          transfers=0):
+                          transfers=0, shard=None):
         eng = self.engine
         tot = eng.occupancy_totals
         idle = width - live - helpers
+        if steps == 0 and refilled == 0:
+            # Pipelined overrun dispatch: the prefetched segment ran zero
+            # steps because every lane finished during the previous one.
+            # Its sync costs are real, but a no-op segment must not become
+            # an occupancy row — consumers weight columns by `steps`, and
+            # a refilled lane always steps at least once, so nothing else
+            # is lost by dropping it.
+            tot["host_ms"] += host_ms
+            tot["device_ms"] += device_ms
+            tot["transfers"] += transfers
+            return
         tot["segments"] += 1
         tot["steps"] += steps
         tot["lane_steps"] += steps * width
@@ -2082,13 +2213,19 @@ class LaneScheduler:
         tot["host_ms"] += host_ms
         tot["device_ms"] += device_ms
         tot["transfers"] += transfers
-        eng.occupancy_log.append({
+        row = {
             "segment": tot["segments"], "width": width, "steps": steps,
             "live": live, "helpers": helpers, "idle": idle,
             "refilled": refilled, "queue": queue,
             "transfers": transfers, "host_ms": host_ms,
             "device_ms": device_ms,
-        })
+        }
+        if shard is not None:
+            # mesh sessions: per-shard busy-lane counts, admissions and
+            # device step counts (shard_live counts LANES — primaries
+            # plus helpers — where the scalar `live` counts positions)
+            row.update(shard)
+        eng.occupancy_log.append(row)
         if len(eng.occupancy_log) > 4096:
             del eng.occupancy_log[:-4096]
         if eng.trace:

@@ -33,13 +33,16 @@ compile_cache deliberately degrades to "no cache" on any error).
 Narrow handlers (`except OSError: pass` around best-effort logging) are
 deliberately not flagged — the rules target *broad* swallowing.
 
-The host-sync rule runs on the LaneScheduler module only: values that
+The host-sync rule runs on the scheduler-loop modules (engine/tpu.py's
+LaneScheduler and ops/search.py's stream/batch loops): values that
 flow from the segment dispatch jits (`_run_segment_jit`,
 `_init_state_jit`, `_merge_lanes_jit`, `refill_lanes`,
-`extract_results`, or a local `dispatch`/`flush_adm` wrapper) are
-device-resident, and the only sanctioned way to materialize one on the
-host inside a `while` loop is `SyncStats.fetch`, which counts the
-transfer and measures the blocked time (utils/syncstats.py).
+`extract_results`, the shard_map'd mesh callables
+`run_segment_sharded`/`refill_lanes_sharded`, or a local
+`dispatch`/`flush_adm` wrapper) are device-resident, and the only
+sanctioned way to materialize one on the host inside a `while` loop is
+`SyncStats.fetch`, which counts the transfer and measures the blocked
+time (utils/syncstats.py).
 `stats.fetch(x)` is naturally absolved — the rule tracks the names, and
 a fetch result is a host value, not a device one.
 """
@@ -67,8 +70,13 @@ BLOCK_SCOPE = (
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine")
 
-# the scheduler loop: blocking host syncs here stall the segment pipeline
-HOST_SYNC_SCOPE = ("fishnet_tpu/engine/tpu.py",)
+# the scheduler loops: blocking host syncs here stall the segment
+# pipeline — engine/tpu.py holds the LaneScheduler, ops/search.py the
+# stream/batch segment loops (both dispatch the sharded mesh callables)
+HOST_SYNC_SCOPE = (
+    "fishnet_tpu/engine/tpu.py",
+    "fishnet_tpu/ops/search.py",
+)
 
 # the session journal lives in the supervisor; its single-writer
 # invariant is what lets the recovery ladder trust exactly-once contents
@@ -79,10 +87,13 @@ _MUT_METHODS = ("update", "pop", "clear", "setdefault", "popitem",
                 "add", "discard", "remove")
 
 # calls whose results are device arrays (or tuples of them); a local
-# `dispatch`/`flush_adm` closure wrapping the segment jit counts too
+# `dispatch`/`flush_adm` closure wrapping the segment jit counts too,
+# as do the shard_map'd mesh callables (parallel/mesh.py) the sharded
+# scheduler drives
 _DEVICE_PRODUCERS = ("_run_segment_jit", "_init_state_jit",
                      "_merge_lanes_jit", "refill_lanes", "extract_results",
-                     "dispatch", "flush_adm")
+                     "dispatch", "flush_adm",
+                     "run_segment_sharded", "refill_lanes_sharded")
 
 # attribute calls that block the caller until a peer acts
 _WAITING_ATTRS = ("join", "get", "wait", "recv")

@@ -1064,30 +1064,23 @@ def _merge_lanes(state: SearchState, fresh: SearchState,
 _merge_lanes_jit = jax.jit(_merge_lanes, donate_argnums=(0, 1))
 
 
-def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
-                 lane_idx, depth, node_budget, *, variant: str = "standard",
-                 hist_hash=None, hist_halfmove=None,
-                 root_alpha=None, root_beta=None,
-                 order_jitter=None, group=None) -> SearchState:
-    """Splice fresh root positions into selected lanes of a running state.
+def _refill_fresh(params: nnue.NnueParams, state: SearchState,
+                  new_roots: Board, lane_idx, depth, node_budget, *,
+                  variant: str = "standard", hist_hash=None,
+                  hist_halfmove=None, root_alpha=None, root_beta=None,
+                  order_jitter=None, group=None):
+    """Build the full-width fresh state and (B,) splice mask for a refill.
 
-    new_roots: batched Board with n rows; lane_idx: host sequence of n
-    distinct lane indices to reinitialize; depth/node_budget (n,) and the
-    optional per-lane arrays follow init_state semantics (None defaults
-    are expanded to the init_state defaults so every call shares ONE
-    _init_state_jit trace with the initial fill).
-
-    Lanes not in lane_idx keep their exact pre-call state — including
-    mid-segment stack contents, accumulators and history — so live
-    searches are unaffected. The caller is responsible for only
-    refilling DONE lanes and for bumping those lanes' TT generation
-    tags before the next _run_segment_jit dispatch."""
+    Shared by the single-device `refill_lanes` and the sharded
+    parallel.mesh.refill_lanes_sharded — the fresh state and mask are
+    mesh-agnostic (the merge is what differs: plain jit vs shard_map).
+    Returns (fresh, mask), or (None, None) when lane_idx is empty."""
     B = state.lane.shape[0]
     max_ply = state.bt.shape[1] - 1
     lane_idx = np.asarray(lane_idx, np.int64).reshape(-1)
     n = int(lane_idx.shape[0])
     if n == 0:
-        return state
+        return None, None
     take = np.zeros(B, np.int64)
     take[lane_idx] = np.arange(n)
     mask = np.zeros(B, bool)
@@ -1118,6 +1111,37 @@ def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
         order_jitter=expand(order_jitter, 0, np.int32),
         group=expand(group, 0, np.int32),
     )
+    return fresh, mask
+
+
+def refill_lanes(params: nnue.NnueParams, state: SearchState, new_roots: Board,
+                 lane_idx, depth, node_budget, *, variant: str = "standard",
+                 hist_hash=None, hist_halfmove=None,
+                 root_alpha=None, root_beta=None,
+                 order_jitter=None, group=None) -> SearchState:
+    """Splice fresh root positions into selected lanes of a running state.
+
+    new_roots: batched Board with n rows; lane_idx: host sequence of n
+    distinct lane indices to reinitialize; depth/node_budget (n,) and the
+    optional per-lane arrays follow init_state semantics (None defaults
+    are expanded to the init_state defaults so every call shares ONE
+    _init_state_jit trace with the initial fill).
+
+    Lanes not in lane_idx keep their exact pre-call state — including
+    mid-segment stack contents, accumulators and history — so live
+    searches are unaffected. The caller is responsible for only
+    refilling DONE lanes and for bumping those lanes' TT generation
+    tags before the next _run_segment_jit dispatch. For a mesh-sharded
+    state use parallel.mesh.refill_lanes_sharded (same contract, merge
+    routed through the shard_map'd splice)."""
+    fresh, mask = _refill_fresh(
+        params, state, new_roots, lane_idx, depth, node_budget,
+        variant=variant, hist_hash=hist_hash, hist_halfmove=hist_halfmove,
+        root_alpha=root_alpha, root_beta=root_beta,
+        order_jitter=order_jitter, group=group,
+    )
+    if fresh is None:
+        return state
     return _merge_lanes_jit(state, fresh, jnp.asarray(mask))
 
 
@@ -1132,6 +1156,7 @@ def search_stream(
     max_steps: int = 50_000_000,
     deadline: float | None = None,
     tt=None,
+    mesh=None,
     variant: str = "standard",
     hist=None,
     prefer_deep_store: bool = False,
@@ -1144,11 +1169,20 @@ def search_stream(
     The occupancy-driven counterpart of `search_batch_resumable`: instead
     of narrowing as lanes finish, the host refills DONE lanes with queued
     positions at every segment boundary, keeping the compiled step at
-    full width until the queue drains. Single-device only (a mesh shard
-    must keep its static width AND its lanes are not host-addressable
-    per-shard); the engine-level LaneScheduler adds helper lanes,
-    aspiration windows and per-position deadlines on top of the same
-    primitives.
+    full width until the queue drains. The engine-level LaneScheduler
+    adds helper lanes, aspiration windows and per-position deadlines on
+    top of the same primitives.
+
+    mesh: optional jax.sharding.Mesh — lanes shard over its devices
+    (width must divide evenly) and segment/refill/merge route through
+    the shard_map'd callables in parallel.mesh: each device advances and
+    resplices ITS lanes locally, the host sees one stacked
+    (ndev, width/ndev + 1, 4) boundary summary per dispatch, and the
+    sharded jits donate state+TT exactly like the single-device path.
+    With a mesh, tt must carry a leading (ndev,) shard dim
+    (parallel.mesh.make_sharded_table) or be None, and each occupancy
+    row gains shard_live / shard_refilled / shard_steps lists (one entry
+    per shard).
 
     pipeline (default FISHNET_TPU_PIPELINE): asynchronous segment
     boundaries — the host fetches ONE packed summary per boundary
@@ -1239,6 +1273,23 @@ def search_stream(
         order_jitter=jnp.zeros((width,), jnp.int32),
         group=jnp.zeros((width,), jnp.int32),
     )
+    ndev = local = 1
+    if mesh is not None:
+        from ..parallel.mesh import (
+            refill_lanes_sharded,
+            run_segment_sharded,
+            shard_batch,
+        )
+
+        ndev = mesh.devices.size
+        if width % ndev != 0:
+            raise ValueError(
+                f"stream width {width} must divide over {ndev} devices")
+        local = width // ndev
+        # place the fresh state sharded BEFORE the first dispatch: the
+        # sharded segment donates its operands, and donation only takes
+        # when the input already carries the program's sharding
+        state = shard_batch(mesh, state)
     gen = np.zeros(width, np.int32)
     next_gen = int(tt_gen_start)
     gen[assigned0] = np.arange(next_gen, next_gen + k, dtype=np.int32)
@@ -1257,11 +1308,29 @@ def search_stream(
     total = 0
     seg_i = 0
 
-    def dispatch(st, table, seg_n):
-        return _run_segment_jit(
-            params, st, table, seg_n, variant, False,
-            prefer_deep_store, jnp.asarray(gen),
-        )
+    if mesh is not None:
+        def dispatch(st, table, seg_n):
+            return run_segment_sharded(
+                mesh, params, st, table, seg_n, variant=variant,
+                prefer_deep=prefer_deep_store, tt_gen=jnp.asarray(gen),
+            )
+    else:
+        def dispatch(st, table, seg_n):
+            return _run_segment_jit(
+                params, st, table, seg_n, variant, False,
+                prefer_deep_store, jnp.asarray(gen),
+            )
+
+    def canon_summ(raw):
+        """Boundary summary → ((width, 4) lane rows, step count,
+        per-shard step list). Single-device summaries are (width+1, 4);
+        sharded ones come back stacked (ndev, local+1, 4) and the step
+        count is the max over shards (devices park independently)."""
+        if mesh is None:
+            return raw[:width], int(raw[width, SUM_DONE]), None
+        lanes = raw[:, :local, :].reshape(width, SUM_W)
+        shard_steps = [int(x) for x in raw[:, local, SUM_DONE]]
+        return lanes, max(shard_steps), shard_steps
 
     def do_refill(st, free, n_ref):
         nonlocal next_gen, refills_total
@@ -1275,11 +1344,37 @@ def search_stream(
         next_gen += n_ref
         hh, hm = hist_rows(take_pos)
         refills_total += n_ref
+        if mesh is not None:
+            return refill_lanes_sharded(
+                mesh, params, st, gather_roots(take_pos), sel,
+                depth[take_pos], node_budget[take_pos], variant=variant,
+                hist_hash=hh, hist_halfmove=hm,
+            )
         return refill_lanes(
             params, st, gather_roots(take_pos), sel,
             depth[take_pos], node_budget[take_pos], variant=variant,
             hist_hash=hh, hist_halfmove=hm,
         )
+
+    def shard_row(free, n_ref, shard_steps):
+        """Per-shard occupancy columns (mesh runs only): live lanes,
+        lanes respliced this boundary, device step counts. lane_pos is
+        sampled pre-refill (do_refill mutates it), so `free` carries the
+        boundary's free-lane snapshot."""
+        if mesh is None:
+            return None
+        busy = lane_pos >= 0
+        busy[free] = False
+        sel = np.asarray(free[:n_ref], np.int64)
+        return {
+            "shard_live": [
+                int(busy[s * local:(s + 1) * local].sum())
+                for s in range(ndev)
+            ],
+            "shard_refilled": np.bincount(
+                sel // local, minlength=ndev).astype(int).tolist(),
+            "shard_steps": shard_steps,
+        }
 
     def pull_pv(st, lanes, pos):
         """Materialize PV rows for finished lanes only: two small
@@ -1290,16 +1385,19 @@ def search_stream(
         out["pv_len"][pos] = stats.fetch(
             jnp.take(st.nt[:, 0, NT_PVLEN], rows, axis=0), "pv_len")
 
-    def record(n, live, n_ref, pend_steps):
+    def record(n, live, n_ref, pend_steps, shard=None):
         nonlocal seg_i, segment_steps
         seg_i += 1
         snap = stats.boundary()
-        occupancy.append({
+        row = {
             "segment": seg_i, "steps": int(n), "live": live,
             "refilled": int(n_ref),
             "idle": width - live - int(n_ref), "queue": len(queue),
             **snap,
-        })
+        }
+        if shard is not None:
+            row.update(shard)
+        occupancy.append(row)
         if ctrl is not None:
             segment_steps = ctrl.update(
                 int(n) >= pend_steps, snap["host_ms"], snap["device_ms"])
@@ -1314,7 +1412,11 @@ def search_stream(
                 break
             state, tt, n, _summ = dispatch(state, tt, segment_steps)
             pend_steps = segment_steps
-            n = int(stats.fetch(n, "steps"))
+            n_arr = np.asarray(stats.fetch(n, "steps")).reshape(-1)
+            shard_steps = (
+                [int(x) for x in n_arr] if mesh is not None else None
+            )
+            n = int(n_arr.max())
             total += n
             lane_done = stats.fetch(
                 state.lane[:, LN_MODE] == MODE_DONE, "done")
@@ -1330,7 +1432,10 @@ def search_stream(
             n_ref = min(len(free), len(queue))
             if n_ref and (deadline is None or _time.monotonic() < deadline):
                 state = do_refill(state, free, n_ref)
-            record(n, live, n_ref, pend_steps)
+            else:
+                n_ref = 0
+            record(n, live, n_ref, pend_steps,
+                   shard_row(free, n_ref, shard_steps))
             if live == 0 and n_ref == 0 and not queue:
                 break
         final_state, final_tt = state, tt
@@ -1359,10 +1464,10 @@ def search_stream(
                 # issuing it now donates p_state/p_tt in place and keeps
                 # the device busy across the host's boundary work
                 nxt = dispatch(p_state, p_tt, nxt_steps)
-            summ = stats.fetch(p_summ, "summary")
-            n = int(summ[width, SUM_DONE])
+            summ, n, shard_steps = canon_summ(
+                stats.fetch(p_summ, "summary"))
             total += n
-            lane_done = summ[:width, SUM_DONE].astype(bool)
+            lane_done = summ[:, SUM_DONE].astype(bool)
             fin = np.nonzero(lane_done & (lane_pos >= 0))[0]
             if fin.size:
                 pos = lane_pos[fin]
@@ -1393,7 +1498,8 @@ def search_stream(
                 cur_state = do_refill(cur_state, free, n_ref)
             else:
                 n_ref = 0
-            record(n, live, n_ref, pend_steps)
+            record(n, live, n_ref, pend_steps,
+                   shard_row(free, n_ref, shard_steps))
             if nxt is not None:
                 pend = nxt
                 pend_steps = nxt_steps
@@ -1522,10 +1628,15 @@ def search_batch_resumable(
         order_jitter=order_jitter, group=group,
     )
     if mesh is not None:
-        from ..parallel.mesh import run_segment_sharded
+        from ..parallel.mesh import run_segment_sharded, shard_batch
+
+        # place the fresh state sharded BEFORE the first dispatch: the
+        # sharded segment donates its operands, and donation only takes
+        # when the input already carries the program's sharding
+        state = shard_batch(mesh, state)
 
         def dispatch(state, tt):
-            state, tt, n = run_segment_sharded(
+            state, tt, n, _summ = run_segment_sharded(
                 mesh, params, state, tt, segment_steps, variant=variant,
                 deep_tt=deep_tt, prefer_deep=prefer_deep_store,
                 tt_gen=tt_gen,

@@ -55,7 +55,7 @@ def replicate(mesh: Mesh, tree):
 
 
 @functools.lru_cache(maxsize=None)
-def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
+def _segment_callable(mesh: Mesh, axis: str, has_tt: bool,
                       variant: str = "standard", deep_tt: bool = False,
                       prefer_deep: bool = False):
     """shard_map'd search segment: each device advances ITS lanes with ITS
@@ -63,48 +63,112 @@ def _segment_callable(mesh: Mesh, axis: str, segment_steps: int, has_tt: bool,
     whose lanes all park in DONE exits its while_loop early instead of
     spinning in lockstep with slower devices. This is the TPU-native
     equivalent of the reference's independent engine processes per core
-    (reference: src/main.rs:151-161)."""
+    (reference: src/main.rs:151-161).
+
+    segment_steps is a TRACED replicated scalar (retuning never recompiles)
+    and tt_gen a per-lane (B,) sharded array. The per-shard packed boundary
+    summary comes back stacked as (ndev, local_B+1, 4) so a no-finish
+    boundary is one small host fetch, and state+TT are donated — a
+    boundary rebinds shard handles instead of copying them."""
     from ..ops.search import _run_segment
 
-    def seg(params, state, ttab, tt_gen):
+    def seg(params, state, ttab, segment_steps, tt_gen):
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[0], ttab)  # (1, N) block → (N,)
-        state, ttab, n, _summ = _run_segment(
+        state, ttab, n, summ = _run_segment(
             params, state, ttab, segment_steps, variant, deep_tt,
             prefer_deep, tt_gen,
         )
         if ttab is not None:
             ttab = jax.tree.map(lambda a: a[None], ttab)
-        return state, ttab, n.reshape(1)
+        return state, ttab, n.reshape(1), summ[None]
 
     fn = _shard_map(
         seg,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis) if has_tt else P(), P()),
-        out_specs=(P(axis), P(axis) if has_tt else P(), P(axis)),
+        in_specs=(P(), P(axis), P(axis) if has_tt else P(), P(), P(axis)),
+        out_specs=(P(axis), P(axis) if has_tt else P(), P(axis),
+                   P(axis, None, None)),
         **_SHARD_MAP_KW,
     )
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(1, 2))
 
 
 def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
                         axis: str = "dp", variant: str = "standard",
                         deep_tt: bool = False, prefer_deep: bool = False,
-                        tt_gen: int = 0):
+                        tt_gen=0):
     """Advance a sharded search ≤ segment_steps on every device.
 
     state: SearchState with lane dim divisible by mesh size. ttab: TTable
     whose arrays carry a leading (n_devices,) shard dim (see
-    make_sharded_table), or None. Returns (state, ttab, steps (ndev,)).
+    make_sharded_table), or None. Returns (state, ttab, steps (ndev,),
+    summary (ndev, B/ndev + 1, 4)) — the packed per-shard boundary
+    summary of ops/search._run_segment, stacked over shards.
+
+    state and ttab are DONATED: the handles passed in are dead after the
+    call and the caller must rebind to the outputs. segment_steps is
+    traced, so retuning the segment length reuses the compiled program.
     prefer_deep/tt_gen: helper-lane TT store policy (ops/tt.py store);
-    the generation scalar is replicated across shards."""
+    tt_gen may be a scalar or a per-lane (B,) array."""
     import jax.numpy as jnp
 
     fn = _segment_callable(
-        mesh, axis, segment_steps, ttab is not None, variant, deep_tt,
-        prefer_deep,
+        mesh, axis, ttab is not None, variant, deep_tt, prefer_deep,
     )
-    return fn(params, state, ttab, jnp.int32(tt_gen))
+    B = int(state.lane.shape[0])
+    gen = jnp.asarray(tt_gen, jnp.int32)
+    if gen.ndim == 0:
+        gen = jnp.full((B,), gen, jnp.int32)
+    return fn(params, state, ttab, jnp.int32(segment_steps), gen)
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_callable(mesh: Mesh, axis: str):
+    """shard_map'd masked lane merge (ops/search._merge_lanes): the splice
+    is elementwise along the lane dim, so each shard merges its own slice
+    of the fresh state — values change, shapes and shardings never, and
+    the segment program keeps running with zero recompiles. Both inputs
+    are donated (the merge rebinds, never copies)."""
+    from ..ops.search import _merge_lanes
+
+    fn = _shard_map(
+        _merge_lanes,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(axis),
+        **_SHARD_MAP_KW,
+    )
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def refill_lanes_sharded(mesh: Mesh, params, state, new_roots, lane_idx,
+                         depth, node_budget, *, axis: str = "dp",
+                         variant: str = "standard", hist_hash=None,
+                         hist_halfmove=None, root_alpha=None, root_beta=None,
+                         order_jitter=None, group=None):
+    """Splice replacement positions into DONE lanes of a SHARDED state.
+
+    Same contract as ops/search.refill_lanes, with the merge routed
+    through the shard_map'd masked splice: each device rewrites only its
+    own lanes, locally. `state` is donated (rebind to the return value).
+    lane_idx is global lane numbering — the host assigns lanes, the
+    shard split falls out of the sharding."""
+    from ..ops.search import _refill_fresh
+
+    fresh, mask = _refill_fresh(
+        params, state, new_roots, lane_idx, depth, node_budget,
+        variant=variant, hist_hash=hist_hash, hist_halfmove=hist_halfmove,
+        root_alpha=root_alpha, root_beta=root_beta,
+        order_jitter=order_jitter, group=group,
+    )
+    if fresh is None:
+        return state
+    import jax.numpy as jnp
+
+    fresh = shard_batch(mesh, fresh, axis)
+    mask_dev = shard_batch(mesh, jnp.asarray(mask), axis)
+    return _merge_callable(mesh, axis)(state, fresh, mask_dev)
 
 
 def make_sharded_table(mesh: Mesh, size_log2: int):

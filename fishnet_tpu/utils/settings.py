@@ -94,6 +94,18 @@ SETTINGS: Tuple[Setting, ...] = (
         engine=True,
     ),
     Setting(
+        name="FISHNET_TPU_MESH_REFILL",
+        kind="bool",
+        default="1",
+        doc="Continuous lane refill on MESH hosts: the LaneScheduler "
+            "drives the shard_map'd segment/refill callables "
+            "(parallel/mesh.py) so each device resplices its own lanes "
+            "locally; 0 pins meshed engines back to strict chunk-serial "
+            "dispatch. No effect on single-device hosts or with "
+            "FISHNET_TPU_REFILL=0.",
+        engine=True,
+    ),
+    Setting(
         name="FISHNET_TPU_NARROW_FLOOR",
         kind="int",
         default="64",

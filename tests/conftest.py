@@ -75,3 +75,8 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running tests (deep perft, big batches)")
     config.addinivalue_line("markers", "tpu: tests that require a real TPU device")
+    config.addinivalue_line(
+        "markers",
+        "mesh: sharded-scheduler tests that require the 8-device "
+        "virtual CPU mesh (XLA_FLAGS=--xla_force_host_platform_"
+        "device_count=8, which conftest forces anyway)")

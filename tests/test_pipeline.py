@@ -162,7 +162,7 @@ def make_refill_engine(**kw):
     kw.setdefault("tt_size_log2", 0)
     kw.setdefault("helper_lanes", 1)
     engine = TpuEngine(refill=True, **kw)
-    engine.mesh = None  # conftest's 8 virtual devices would disable refill
+    engine.mesh = None  # single-device semantics (mesh suite is separate)
     engine.n_dev = 1
     return engine
 

@@ -12,10 +12,11 @@ ops/search.py refill_lanes/search_stream):
    chunks share the engine concurrently through the combining driver.
 
 conftest.py sets FISHNET_TPU_REFILL=0, so engines here opt in explicitly
-with refill=True. The scheduler only engages off-mesh (lanes are not
-host-addressable per shard), and conftest's 8 virtual CPU devices give
-every test engine a mesh — refill engines force engine.mesh = None, which
-is exactly what a single-device production host looks like.
+with refill=True. This file pins the SINGLE-DEVICE scheduler semantics:
+refill engines force engine.mesh = None, which is exactly what a
+single-device production host looks like (conftest's 8 virtual CPU
+devices would otherwise give every engine a mesh — the sharded
+scheduler path has its own suite, tests/test_mesh_refill.py).
 """
 import asyncio
 import threading
@@ -58,13 +59,13 @@ def run(engine, chunk):
 
 
 def make_refill_engine(**kw):
-    """Refill-on engine in the configuration the scheduler requires:
-    single-device (mesh=None), no helper coupling unless asked."""
+    """Refill-on engine in the single-device configuration this suite
+    pins (mesh=None), no helper coupling unless asked."""
     kw.setdefault("max_depth", 3)
     kw.setdefault("tt_size_log2", 0)
     kw.setdefault("helper_lanes", 1)
     engine = TpuEngine(refill=True, **kw)
-    engine.mesh = None  # conftest's 8 virtual devices would disable refill
+    engine.mesh = None  # single-device semantics (mesh suite is separate)
     engine.n_dev = 1
     return engine
 
@@ -109,16 +110,18 @@ def test_refill_off_never_touches_scheduler():
     assert all(r.best_move for r in responses)
 
 
-def test_refill_disabled_under_mesh():
-    """Sharded lanes are not host-addressable, so a meshed engine must
-    fall back to serial dispatch even with refill enabled."""
+def test_mesh_refill_optout_falls_back_to_serial():
+    """FISHNET_TPU_MESH_REFILL=0 (mesh_refill=False) pins a MESHED
+    engine back to strict chunk-serial dispatch even with refill on —
+    the scheduler must never engage. (With mesh_refill on, the meshed
+    scheduler path is covered by tests/test_mesh_refill.py.)"""
     engine = TpuEngine(max_depth=2, tt_size_log2=0, helper_lanes=1,
-                       refill=True)
+                       refill=True, mesh_refill=False)
     assert engine.mesh is not None  # conftest provides 8 virtual devices
     _stub_search(engine)
 
     def boom(chunk):
-        raise AssertionError("scheduler engaged under a mesh")
+        raise AssertionError("scheduler engaged with mesh refill opted out")
 
     engine._scheduler.run_chunk = boom
     responses = run(engine, make_chunk(analysis_work(depth=2)))

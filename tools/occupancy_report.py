@@ -28,6 +28,15 @@ runs the stage twice (FISHNET_TPU_PIPELINE off, then on) and FAILS on
 any per-position result divergence: the pipelined loop must be
 bit-identical to the round-7 synchronous loop.
 
+Round 10 (mesh parity): --mesh-ab runs the stage single-device and then
+sharded over every local device (search_stream(mesh=make_mesh())) and
+FAILS on any per-position result divergence — shard-local refill and the
+stacked boundary summary must be bit-identical to the flat stream. The
+TT is disabled for both passes when set (a sharded table hashes into
+per-device shards, which legitimately changes move ordering). Sharded
+rows grow a per-shard live-lane column and the JSON summary a per-shard
+mean live fraction list.
+
 Round 9 (session recovery): --stats-db PATH reads the client's sqlite
 stats store and prepends the latest SupervisorStats snapshot (replay /
 bisection / quarantine counters, exported by the client's summary loop)
@@ -134,6 +143,10 @@ def main() -> int:
     ap.add_argument("--pipeline-ab", action="store_true",
                     help="run the stage with the segment pipeline off "
                          "then on; FAIL on any result divergence")
+    ap.add_argument("--mesh-ab", action="store_true",
+                    help="run the stage single-device then sharded over "
+                         "all local devices (TT disabled for both); FAIL "
+                         "on any result divergence")
     ap.add_argument("--format", choices=("text", "github"), default="text")
     ap.add_argument("--json", action="store_true",
                     help="print a machine-readable summary line")
@@ -189,11 +202,27 @@ def main() -> int:
     depth = np.full(n, args.depth, np.int32)
     budget = np.full(n, args.budget, np.int32)
 
-    def run(pipeline=None):
+    mesh = None
+    if args.mesh_ab:
+        from fishnet_tpu.parallel.mesh import make_mesh
+
+        ndev = jax.device_count()
+        if args.lanes % ndev:
+            print(f"ERROR: --mesh-ab needs --lanes divisible by the "
+                  f"{ndev} local devices")
+            return 1
+        mesh = make_mesh()
+        if args.tt_log2:
+            # a sharded table hashes into per-device shards; that
+            # legitimately reorders moves, so the A/B drops the TT
+            print("mesh A/B: TT disabled for both passes "
+                  "(sharded vs flat tables hash differently)")
+
+    def run(pipeline=None, on_mesh=None):
         # the table (and the running state) are DONATED into the segment
         # jits, so every pass gets its own fresh table
         tt = None
-        if args.tt_log2:
+        if args.tt_log2 and not args.mesh_ab:
             from fishnet_tpu.ops import tt as tt_mod
 
             tt = tt_mod.make_table(args.tt_log2)
@@ -201,17 +230,18 @@ def main() -> int:
         out = S.search_stream(
             params, roots, depth, budget, max_ply=args.max_ply,
             width=args.lanes, segment_steps=args.segment, tt=tt,
-            pipeline=pipeline,
+            mesh=on_mesh, pipeline=pipeline,
         )
         jax.block_until_ready(out["nodes"])
         return out, time.perf_counter() - t0
 
     legacy = None
     if args.pipeline_ab:
-        legacy = run(pipeline=False)
-        out, wall = run(pipeline=True)
+        legacy = run(pipeline=False, on_mesh=mesh)
+        out, wall = run(pipeline=True, on_mesh=mesh)
     else:
-        out, wall = run()
+        out, wall = run(on_mesh=mesh)
+    flat_base = run(pipeline=False) if args.mesh_ab else None
 
     # ops-level rows: {segment, steps, live, refilled, idle, queue} plus
     # the round-8 syncstats columns {transfers, host_ms, device_ms}
@@ -226,23 +256,28 @@ def main() -> int:
     transfers = sum(o["transfers"] for o in occ)
     done = int(np.asarray(out["done"]).sum())
 
+    has_shard = bool(occ) and "shard_live" in occ[0]
+    shard_hdr = f" {'shard live':>18}" if has_shard else ""
     print(f"{'seg':>4} {'steps':>6} {'live':>5} {'idle':>5} "
           f"{'refill':>6} {'queue':>5} {'xfers':>5} {'host_ms':>8} "
-          f"{'dev_ms':>8} {'share':>6}")
+          f"{'dev_ms':>8} {'share':>6}{shard_hdr}")
     for o in occ:
         tot = o["host_ms"] + o["device_ms"]
         share = o["host_ms"] / tot if tot > 0 else 0.0
+        shard_col = ""
+        if has_shard:
+            shard_col = " " + ",".join(str(x) for x in o["shard_live"])
         print(f"{o['segment']:>4} {o['steps']:>6} {o['live']:>5} "
               f"{o['idle']:>5} {o['refilled']:>6} {o['queue']:>5} "
               f"{o['transfers']:>5} {o['host_ms']:>8.2f} "
-              f"{o['device_ms']:>8.2f} {share:>6.3f}")
+              f"{o['device_ms']:>8.2f} {share:>6.3f}{shard_col}")
     print(f"positions {done}/{n} done, width {args.lanes}, "
           f"{len(occ)} segments, {out['refills']} refills, "
           f"mean live fraction {mean_live:.3f}, "
           f"boundary share {boundary_share:.3f} "
           f"({transfers} transfers), wall {wall:.2f}s")
     if args.json:
-        print("OCCUPANCY " + json.dumps({
+        summary = {
             "lanes": args.lanes, "positions": n, "done": done,
             "segments": len(occ), "refills": out["refills"],
             "mean_live_frac": round(mean_live, 4),
@@ -251,7 +286,18 @@ def main() -> int:
             "boundary_share": round(boundary_share, 4),
             "transfers": transfers,
             "wall_s": round(wall, 3),
-        }))
+        }
+        if has_shard:
+            ndev = len(occ[0]["shard_live"])
+            local = args.lanes // ndev
+            denom = sum(o["steps"] * local for o in occ) or 1
+            summary["ndev"] = ndev
+            summary["shard_mean_live"] = [
+                round(sum(o["steps"] * o["shard_live"][s] for o in occ)
+                      / denom, 4)
+                for s in range(ndev)
+            ]
+        print("OCCUPANCY " + json.dumps(summary))
 
     if legacy is not None:
         lout, lwall = legacy
@@ -270,6 +316,25 @@ def main() -> int:
                    "must be bit-identical")
             if args.format == "github":
                 print(f"::error title=pipeline-ab divergence::{msg}")
+            else:
+                print(f"ERROR: {msg}")
+            return 1
+
+    if flat_base is not None:
+        fout, fwall = flat_base
+        diverged = []
+        for key in ("score", "move", "nodes", "pv_len", "pv", "done"):
+            if not np.array_equal(np.asarray(fout[key]),
+                                  np.asarray(out[key])):
+                diverged.append(key)
+        print(f"mesh A/B: single-device {fwall:.2f}s / sharded "
+              f"{wall:.2f}s over {mesh.devices.size} devices")
+        if diverged:
+            msg = (f"sharded results diverge from the single-device "
+                   f"stream on: {', '.join(diverged)} — shard-local "
+                   "refill must be bit-identical")
+            if args.format == "github":
+                print(f"::error title=mesh-ab divergence::{msg}")
             else:
                 print(f"ERROR: {msg}")
             return 1
