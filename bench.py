@@ -240,12 +240,17 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
         return done, nodes, out["tt"], summary
 
     run = stream_pass if stream else serial_pass
+    from fishnet_tpu.obs import trace
+
+    refill_mode = "stream" if stream else "serial"
     _hb(t0, "exec_start warmup pass (compiles all programs)")
-    done, nodes, tt, occ = run(tt)
+    with trace.span("bench.warmup", "bench", mode=refill_mode, B=B, N=N):
+        done, nodes, tt, occ = run(tt)
     _hb(t0, f"exec_done warmup (done={done}/{N})")
     _hb(t0, "exec_start timed pass")
     t1 = time.perf_counter()
-    done, nodes, tt, occ = run(tt)
+    with trace.span("bench.search", "bench", mode=refill_mode, B=B, N=N):
+        done, nodes, tt, occ = run(tt)
     dt = time.perf_counter() - t1
     _hb(t0, f"exec_done timed: done={done}/{N}, {nodes:,} nodes in {dt:.2f}s")
     print(
@@ -273,6 +278,13 @@ def _bench_refill(t0: float, params, B: int, depth: int, budget: int,
         }),
         flush=True,
     )
+    rec = trace.RECORDER
+    if rec is not None:
+        path = rec.flight_dump(
+            settings.get_str("FISHNET_TPU_TRACE_DIR"),
+            f"bench-refill-{'stream' if stream else 'serial'}-b{B}",
+        )
+        _hb(t0, f"trace dumped to {path}")
 
 
 def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
@@ -280,21 +292,27 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     """Child process: run one (B, depth) stage with phase heartbeats.
 
     On success prints exactly one stdout line: RESULT {json}."""
+    from fishnet_tpu.obs import trace
     from fishnet_tpu.utils import settings
 
-    t0 = time.time()
+    # phase transitions go through the shared recorder (off unless
+    # FISHNET_TPU_TRACE_DIR is set), so a bench run produces the same
+    # Chrome-trace timeline as the engine — not just stderr stamps
+    rec = trace.install_from_settings("bench")
+    t0 = time.monotonic()
     mode = ("select" if settings.get_bool("FISHNET_TPU_SELECT_UPDATES")
             else "scatter")
     _hb(t0, f"stage B={B} depth={depth} variant={variant} set={fen_set} "
             f"row_mode={mode}: importing jax")
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    with trace.span("bench.import_jax", "bench"):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
 
-    from fishnet_tpu.utils import enable_compile_cache
+        from fishnet_tpu.utils import enable_compile_cache
 
-    enable_compile_cache()
-    platform = jax.default_backend()
+        enable_compile_cache()
+        platform = jax.default_backend()
     _hb(t0, f"devices={jax.devices()} platform={platform}")
 
     from fishnet_tpu.models import nnue
@@ -412,11 +430,12 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # compile each program explicitly so a compiler hang is distinguishable
     # from an execution hang in the heartbeat tail
     _hb(t0, "compile_start init_state")
-    state = S._init_state_jit(
-        params, roots, depth_arr, budget_arr, max_ply, variant,
-        order_jitter=order_jitter, group=group,
-    )
-    jax.block_until_ready(state.bt)
+    with trace.span("bench.compile", "bench", program="init_state"):
+        state = S._init_state_jit(
+            params, roots, depth_arr, budget_arr, max_ply, variant,
+            order_jitter=order_jitter, group=group,
+        )
+        jax.block_until_ready(state.bt)
     _hb(t0, "compile_done init_state (and executed)")
     # short segments let the lane-narrowing path retire finished lanes
     # mid-batch (ops/search.py search_batch_resumable narrow=True) — with
@@ -429,12 +448,14 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     # operand, so even its weak-vs-strong int32 typing must match or
     # this precompile misses and a cold XLA compile lands in the timed
     # region
-    lowered = S._run_segment_jit.lower(
-        params, state, tt, seg, variant, False, prefer_deep,
-        jnp.int32(tt_gen),
-    )
-    _hb(t0, "  lowered")
-    lowered.compile()
+    with trace.span("bench.compile", "bench", program="run_segment",
+                    seg=seg):
+        lowered = S._run_segment_jit.lower(
+            params, state, tt, seg, variant, False, prefer_deep,
+            jnp.int32(tt_gen),
+        )
+        _hb(t0, "  lowered")
+        lowered.compile()
     _hb(t0, "compile_done run_segment")
     # pre-compile every narrowed width down to the floor: the warmup and
     # timed runs can take DIFFERENT narrowing trajectories (a warm TT
@@ -447,10 +468,12 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
     while w >= 64:
         sub = jax.tree.map(lambda a: a[:w], state)
         _hb(t0, f"compile_start run_segment(width={w})")
-        S._run_segment_jit.lower(
-            params, sub, tt, seg, variant, False, prefer_deep,
-            jnp.int32(tt_gen),
-        ).compile()
+        with trace.span("bench.compile", "bench", program="run_segment",
+                        width=w):
+            S._run_segment_jit.lower(
+                params, sub, tt, seg, variant, False, prefer_deep,
+                jnp.int32(tt_gen),
+            ).compile()
         w //= 2
     _hb(t0, "compile_done narrowed widths")
 
@@ -459,22 +482,24 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
         prefer_deep_store=prefer_deep, tt_gen=tt_gen,
     )
     _hb(t0, "exec_start warmup search")
-    out = S.search_batch_resumable(
-        params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt, variant=variant, **helper_kw,
-    )
-    tt = out.pop("tt")
-    jax.block_until_ready(out["nodes"])
+    with trace.span("bench.warmup", "bench", B=Bt, depth=depth):
+        out = S.search_batch_resumable(
+            params, roots, depth_arr, budget_arr, max_ply=max_ply,
+            segment_steps=seg, tt=tt, variant=variant, **helper_kw,
+        )
+        tt = out.pop("tt")
+        jax.block_until_ready(out["nodes"])
     _hb(t0, f"exec_done warmup (steps={int(out['steps'])})")
 
     _hb(t0, "exec_start timed search")
     t1 = time.perf_counter()
-    out = S.search_batch_resumable(
-        params, roots, depth_arr, budget_arr, max_ply=max_ply,
-        segment_steps=seg, tt=tt, variant=variant, **helper_kw,
-    )
-    out.pop("tt")
-    jax.block_until_ready(out["nodes"])
+    with trace.span("bench.search", "bench", B=Bt, depth=depth):
+        out = S.search_batch_resumable(
+            params, roots, depth_arr, budget_arr, max_ply=max_ply,
+            segment_steps=seg, tt=tt, variant=variant, **helper_kw,
+        )
+        out.pop("tt")
+        jax.block_until_ready(out["nodes"])
     dt = time.perf_counter() - t1
     total_nodes = int(np.asarray(out["nodes"]).sum())
     primary_nodes = int(np.asarray(out["nodes"])[:B].sum())
@@ -508,6 +533,12 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
         ),
         flush=True,
     )
+    if rec is not None:
+        path = rec.flight_dump(
+            settings.get_str("FISHNET_TPU_TRACE_DIR"),
+            f"bench-b{B}-d{depth}",
+        )
+        _hb(t0, f"trace dumped to {path}")
 
 
 def run_stage(B: int, depth: int, budget: int, timeout: float,
@@ -518,7 +549,7 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
     """Parent: launch one stage subprocess; return its RESULT or None."""
     import tempfile
 
-    t0 = time.time()
+    t0 = time.monotonic()
     cmd = [sys.executable, os.path.abspath(__file__),
            "--stage", str(B), str(depth), str(budget), variant, fen_set]
     env = dict(os.environ)
@@ -556,7 +587,7 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
                 print(line, file=sys.stderr, flush=True)
     if r.returncode != 0:
         print(f"bench stage B={B} d={depth} rc={r.returncode} "
-              f"({time.time() - t0:.0f}s)", file=sys.stderr, flush=True)
+              f"({time.monotonic() - t0:.0f}s)", file=sys.stderr, flush=True)
         return None
     for line in r.stdout.splitlines():
         if line.startswith("RESULT "):
@@ -588,7 +619,7 @@ def main() -> None:
     BUDGET = int(os.environ.get("BENCH_BUDGET", "200000"))
     stage_timeout = float(os.environ.get("BENCH_STAGE_TIMEOUT", "420"))
     total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "1800"))
-    t_start = time.time()
+    t_start = time.monotonic()
 
     stages = [s for s in STAGES if s[0] <= B]
     if (B, DEPTH) not in stages:
@@ -605,7 +636,7 @@ def main() -> None:
     # candidate-fix mode (SELECT_FIRST) and fall back per shape
     good_mode: bool | None = None
     for b, d in stages:
-        if time.time() - t_start > total_budget - stage_timeout:
+        if time.monotonic() - t_start > total_budget - stage_timeout:
             print("bench: total budget nearly spent; stopping ramp",
                   file=sys.stderr, flush=True)
             break
@@ -617,7 +648,7 @@ def main() -> None:
             if res is not None:
                 good_mode = m
                 break
-            if time.time() - t_start > total_budget - stage_timeout:
+            if time.monotonic() - t_start > total_budget - stage_timeout:
                 break
         if res is None:
             fails += 1
@@ -727,7 +758,7 @@ def main() -> None:
               "BENCH_TT_LOG2": "21", "BENCH_HELPERS": "4"}),
         ]
         for name, b, d, var, fset, xenv in cfg_stages:
-            remaining = total_budget - (time.time() - t_start)
+            remaining = total_budget - (time.monotonic() - t_start)
             if remaining < 120.0:
                 print(f"bench: skipping {name} (budget spent)",
                       file=sys.stderr, flush=True)
@@ -762,7 +793,7 @@ def main() -> None:
               file=sys.stderr, flush=True)
         fallbacks = ((64, 3), (8, 2))
         for i, (b, d) in enumerate(fallbacks):
-            remaining = total_budget - (time.time() - t_start)
+            remaining = total_budget - (time.monotonic() - t_start)
             # keep a reserve so the last-resort tiny stage always gets a
             # real slice of budget even if the wide stage times out
             reserve = 180.0 * (len(fallbacks) - 1 - i)

@@ -15,6 +15,8 @@ from pathlib import Path
 from typing import Optional, Set
 
 from ..engine.pyengine import PyEngine
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils import settings
 from .api import ApiClient, ApiError, Endpoint
 from .configure import Config
@@ -145,6 +147,17 @@ async def run(cfg: Config) -> int:
         db_file=Path("stats.db") if not cfg.no_stats_file else None,
         cores=cfg.cores,
     )
+    # observability opt-ins: the client-side trace ring (the supervisor
+    # merges the engine host's spans into it and dumps it as the flight
+    # recorder) and the Prometheus text endpoint on loopback
+    if obs_trace.RECORDER is None:
+        obs_trace.install_from_settings("client")
+    metrics_server = obs_metrics.serve_from_settings()
+    if metrics_server is not None:
+        logger.info(
+            "Serving metrics at "
+            f"http://127.0.0.1:{metrics_server.server_address[1]}/metrics"
+        )
     queue = Queue(
         api,
         cores=cfg.cores,
@@ -232,7 +245,15 @@ async def run(cfg: Config) -> int:
             # (tools/occupancy_report.py --stats-db)
             eng = factory.peek_tpu()
             if eng is not None and hasattr(eng, "stats"):
-                stats.record_supervisor(asdict(eng.stats))
+                sup = asdict(eng.stats)
+                stats.record_supervisor(sup)
+                # mirror the supervisor's ad-hoc counters into the
+                # metrics registry (tentpole: one interface over the
+                # scattered counter piles)
+                obs_metrics.REGISTRY.absorb_totals("fishnet_supervisor", sup)
+            # fold the registry into the sqlite time series on the same
+            # cadence as the summary line
+            stats.record_metrics(obs_metrics.REGISTRY.snapshot())
 
     summary = asyncio.ensure_future(summary_loop())
 

@@ -16,6 +16,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Set, Union
 
+from ..obs import trace as obs_trace
 from .api import Acquired, AcquiredKind, ApiClient, ApiError
 from .backoff import RandomizedBackoff
 from .ipc import Chunk, ChunkFailed, PositionResponse
@@ -314,7 +315,8 @@ class Queue:
                     continue
 
                 try:
-                    acquired = await self.api.acquire(slow)
+                    with obs_trace.span("queue.acquire", "client", slow=slow):
+                        acquired = await self.api.acquire(slow)
                 except ApiError:
                     continue  # backoff already applied inside the client
                 if acquired.kind == AcquiredKind.ACCEPTED and acquired.body:

@@ -99,6 +99,17 @@ class StatsRecorder:
                         " batch_id TEXT,"
                         " position_index INTEGER)"
                     )
+                    # metrics registry fold-in (obs/metrics.py snapshot):
+                    # one row per (summary tick, metric), so the sqlite
+                    # sink carries the same series the Prometheus
+                    # endpoint exposes
+                    self._db.execute(
+                        "CREATE TABLE IF NOT EXISTS metrics ("
+                        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+                        " timestamp INTEGER NOT NULL,"
+                        " name TEXT NOT NULL,"
+                        " value REAL NOT NULL)"
+                    )
                     self._db.commit()
                 except sqlite3.Error:
                     self._db = None
@@ -120,7 +131,9 @@ class StatsRecorder:
                     "INSERT INTO stats (timestamp, total_batches, total_positions,"
                     " total_nodes, nnue_nps) VALUES (?, ?, ?, ?, ?)",
                     (
-                        int(time.time()),
+                        # report timestamp, not a duration — wall clock
+                        # is the sanctioned form here
+                        int(time.time()),  # fishnet-lint: disable=obs-wall-clock
                         self.stats.total_batches,
                         self.stats.total_positions,
                         self.stats.total_nodes,
@@ -140,6 +153,8 @@ class StatsRecorder:
                 self._db.execute(
                     "INSERT INTO supervisor_stats (timestamp, counters)"
                     " VALUES (?, ?)",
+                    # report timestamp (see record_metrics)
+                    # fishnet-lint: disable=obs-wall-clock
                     (int(time.time()), json.dumps(self.last_supervisor)),
                 )
                 self._db.commit()
@@ -160,11 +175,32 @@ class StatsRecorder:
                     "INSERT INTO supervisor_quarantine"
                     " (timestamp, fingerprint, batch_id, position_index)"
                     " VALUES (?, ?, ?, ?)",
+                    # report timestamp (see record_metrics)
+                    # fishnet-lint: disable=obs-wall-clock
                     (int(time.time()), fingerprint, batch_id, position_index),
                 )
                 self._db.commit()
             except sqlite3.Error:
                 pass
+
+    def record_metrics(self, snapshot: dict) -> None:
+        """Fold one metrics-registry snapshot (obs/metrics.py: flat
+        name → value) into the time-series sink on the summary cadence."""
+        if self._db is None or not snapshot:
+            return
+        # wall clock is the sanctioned form for REPORT timestamps (rows
+        # correlated with external logs), not durations
+        ts = int(time.time())  # fishnet-lint: disable=obs-wall-clock
+        try:
+            self._db.executemany(
+                "INSERT INTO metrics (timestamp, name, value)"
+                " VALUES (?, ?, ?)",
+                [(ts, name, float(value))
+                 for name, value in sorted(snapshot.items())],
+            )
+            self._db.commit()
+        except sqlite3.Error:
+            pass
 
     def min_user_backlog(self) -> float:
         """Seconds of user-queue backlog below which this client should not

@@ -12,6 +12,7 @@ import time
 from typing import Dict, List, Optional, Union
 
 from ..engine.base import Engine, EngineError
+from ..obs import trace as obs_trace
 from .backoff import RandomizedBackoff
 from .ipc import Chunk, ChunkFailed, PositionResponse
 from .logger import Logger
@@ -63,9 +64,13 @@ async def worker(
                 responses = ChunkFailed(chunk.work.id)
                 continue
             try:
-                responses = await asyncio.wait_for(
-                    engine.go_multiple(chunk), timeout=timeout
-                )
+                with obs_trace.span(
+                    "worker.chunk", "client", worker=index,
+                    batch=str(chunk.work.id), positions=len(chunk.positions),
+                ):
+                    responses = await asyncio.wait_for(
+                        engine.go_multiple(chunk), timeout=timeout
+                    )
                 backoffs.setdefault(flavor, RandomizedBackoff()).reset()
             except asyncio.TimeoutError:
                 logger.warn(

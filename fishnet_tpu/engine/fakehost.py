@@ -165,6 +165,12 @@ def main(argv=None) -> int:
     p.add_argument("--helpers", type=int, default=None)
     p.add_argument("--refill", type=int, default=None)
     p.add_argument("--partials", type=int, default=1)
+    # clock-sync fault injection (obs/trace.py ClockSync): report a
+    # monotonic clock running S seconds BEHIND the real one in hb/ready
+    # `mono` fields, and stream a synthetic child trace ring stamped on
+    # that same skewed clock — the supervisor's offset estimate must
+    # land the merged events back on the parent timeline regardless
+    p.add_argument("--trace-skew", type=float, default=None)
     args = p.parse_args(argv)
 
     script = _load_script(args.script)
@@ -193,12 +199,17 @@ def main(argv=None) -> int:
         with wlock:
             write_frame(stdout, obj)
 
+    def fake_mono() -> float:
+        # the child's (possibly skewed) view of its monotonic clock
+        return time.monotonic() - (args.trace_skew or 0.0)
+
     def ticker() -> None:
         seq = 0
         while not stalled.wait(args.hb_interval):
             seq += 1
             try:
-                send({"t": "hb", "phase": "fake", "busy_s": 0.0, "seq": seq})
+                send({"t": "hb", "phase": "fake", "busy_s": 0.0,
+                      "seq": seq, "mono": fake_mono()})
             except OSError:
                 os._exit(1)
 
@@ -216,7 +227,7 @@ def main(argv=None) -> int:
         freeze()
     elif boot.startswith("slow:"):
         time.sleep(float(boot.split(":", 1)[1]))
-    send({"t": "ready"})
+    send({"t": "ready", "mono": fake_mono()})
 
     while True:
         try:
@@ -233,6 +244,17 @@ def main(argv=None) -> int:
         fps = [wire_position_fingerprint(wp) for wp in positions]
         echo({"t": "go", "positions": len(positions), "fps": fps})
         action = _action(script.get("chunks"), state.bump("chunks"), "ok")
+
+        if args.trace_skew is not None:
+            # one synthetic span per chunk, stamped on the SKEWED clock
+            # (same epoch the mono fields report) — the supervisor must
+            # shift it back onto the parent timeline when absorbing
+            send({"t": "trace", "events": [{
+                "name": "fake.search", "cat": "host", "ph": "X",
+                "ts": fake_mono() * 1e6,
+                "dur": args.hb_interval * 1e6,
+                "pid": os.getpid(), "tid": 1,
+            }]})
 
         def send_partial(wp: dict, times: int = 1, cp: int = FAKE_CP) -> None:
             frame = {

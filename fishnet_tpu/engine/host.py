@@ -40,8 +40,10 @@ import asyncio
 import os
 import sys
 import threading
+import time
 
 from ..client.ipc import chunk_from_wire, position_fingerprint, response_to_wire
+from ..obs import trace
 from ..utils.heartbeat import PhaseTracker
 from .frames import FrameError, PipeClosed, read_frame, write_frame
 
@@ -101,6 +103,13 @@ def main(argv=None) -> int:
 
     wlock = threading.Lock()
     phases = PhaseTracker("boot")
+    # the host records its own ring (FISHNET_TPU_TRACE_DIR is forwarded
+    # by the supervisor's engine_env overlay); the ticker streams
+    # increments to the parent, which owns the merged timeline — a
+    # SIGKILL'd child loses nothing that already crossed the pipe
+    recorder = trace.install_from_settings("engine-host")
+    if recorder is not None:
+        recorder.set_thread_name("host-main")
 
     def send(obj: dict) -> None:
         with wlock:
@@ -114,12 +123,27 @@ def main(argv=None) -> int:
 
     stop = threading.Event()
 
+    def send_trace() -> None:
+        """Drain the ring into trace frames (batched well under the
+        8 MiB frame cap)."""
+        if recorder is None:
+            return
+        events = recorder.drain()
+        while events:
+            batch, events = events[:2000], events[2000:]
+            send({"t": "trace", "events": batch})
+
     def ticker() -> None:
         while not stop.wait(args.hb_interval):
             snap = phases.snapshot()
             snap["t"] = "hb"
+            # child monotonic reading: the supervisor's ClockSync pairs
+            # it with its own receive time to map our timestamps onto
+            # the parent timeline (re-checked every heartbeat)
+            snap["mono"] = time.monotonic()
             try:
                 send(snap)
+                send_trace()
             except OSError:
                 os._exit(1)  # parent gone; nothing left to serve
 
@@ -127,11 +151,12 @@ def main(argv=None) -> int:
 
     phases.enter("warmup")
     try:
-        engine = _build_engine(args, log)
+        with trace.span("warmup", "host"):
+            engine = _build_engine(args, log)
     except Exception as e:
         log(f"engine construction/warmup failed: {type(e).__name__}: {e}")
         return 1
-    send({"t": "ready"})
+    send({"t": "ready", "mono": time.monotonic()})
     phases.enter("idle")
 
     # stream each finished position the moment the engine's exactly-once
@@ -171,7 +196,9 @@ def main(argv=None) -> int:
         cur["id"] = msg.get("id")
         phases.enter("search")
         try:
-            responses = asyncio.run(engine.go_multiple(chunk))
+            with trace.span("search", "host", id=msg.get("id"),
+                            positions=len(chunk.positions)):
+                responses = asyncio.run(engine.go_multiple(chunk))
         except Exception as e:
             send({
                 "t": "err",
@@ -190,6 +217,10 @@ def main(argv=None) -> int:
         asyncio.run(engine.close())
     except Exception as e:
         log(f"engine close failed: {type(e).__name__}: {e}")
+    try:
+        send_trace()  # final flush: a clean quit ships the tail too
+    except OSError:
+        pass
     return 0
 
 

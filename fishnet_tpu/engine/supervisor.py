@@ -65,6 +65,7 @@ from ..client.ipc import (
     responses_from_wire,
 )
 from ..client.logger import Logger
+from ..obs import trace as obs_trace
 from ..utils import settings
 from .base import EngineError
 from .frames import FrameError, PipeClosed, encode, read_frame_async
@@ -243,6 +244,17 @@ class SupervisedEngine:
         self._quarantine: Set[str] = set()
         self._ladder_active = False
         self._stats_recorder = stats_recorder
+        # trace timeline (obs/trace.py): when FISHNET_TPU_TRACE_DIR is
+        # set, the parent ring holds the merged supervisor+host timeline
+        # (the child streams increments over trace frames) and the
+        # recovery ladder dumps it as the flight recorder. Install the
+        # module-global recorder only if the app hasn't already.
+        self._trace_dir = settings.get_str("FISHNET_TPU_TRACE_DIR")
+        if self._trace_dir and obs_trace.RECORDER is None:
+            obs_trace.install_from_settings("supervisor")
+        # child-monotonic → parent-monotonic mapping; rebuilt per child
+        # incarnation in _spawn (each process has its own epoch)
+        self._clock = obs_trace.ClockSync()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -384,11 +396,17 @@ class SupervisedEngine:
         self._journal_reset(expect=[position_fingerprint(wp) for wp in wps])
         self._pending = (gid, fut)
         try:
-            await self._send({"t": "go", "id": gid, "chunk": chunk_to_wire(sub)})
-            reply = await self._watch(
-                fut, deadline, kill_on_deadline=True,
-                label=f"chunk of batch {chunk.work.id}",
-            )
+            with obs_trace.span(
+                "supervisor.dispatch", "supervisor",
+                id=gid, batch=str(chunk.work.id), positions=len(wps),
+            ):
+                await self._send(
+                    {"t": "go", "id": gid, "chunk": chunk_to_wire(sub)}
+                )
+                reply = await self._watch(
+                    fut, deadline, kill_on_deadline=True,
+                    label=f"chunk of batch {chunk.work.id}",
+                )
         finally:
             self._pending = None
         if reply.get("t") == "err":
@@ -681,6 +699,12 @@ class SupervisedEngine:
         self._down_noted = False
         self._last_frame = time.monotonic()
         self._phase = {}
+        # fresh child, fresh monotonic epoch: the old offset is garbage
+        self._clock = obs_trace.ClockSync()
+        rec = obs_trace.RECORDER
+        if rec is not None:
+            rec.set_process_name("engine-host", pid=self.proc.pid)
+            rec.instant("spawn", "supervisor", pid=self.proc.pid)
         ready = asyncio.get_running_loop().create_future()
         ready.add_done_callback(_consume_exc)
         self._ready = ready
@@ -706,9 +730,31 @@ class SupervisedEngine:
                 t = msg.get("t")
                 if t == "hb":
                     self._phase = msg
+                    mono = msg.get("mono")
+                    if isinstance(mono, (int, float)):
+                        # re-check the clock offset on every heartbeat;
+                        # ClockSync keeps the min (= least pipe latency)
+                        self._clock.sample(float(mono), self._last_frame)
                 elif t == "ready":
+                    mono = msg.get("mono")
+                    if isinstance(mono, (int, float)):
+                        # config-time estimate: first usable offset
+                        self._clock.sample(float(mono), self._last_frame)
                     if not ready_fut.done():
                         ready_fut.set_result(True)
+                elif t == "trace":
+                    # merge the child's drained ring increment onto the
+                    # parent timeline (host.py ships a hb frame carrying
+                    # "mono" before any trace frame, so an offset exists
+                    # by the time events arrive; 0.0 is a safe fallback
+                    # for hosts that never sent one)
+                    rec = obs_trace.RECORDER
+                    if rec is not None:
+                        off = self._clock.offset_us
+                        rec.absorb(
+                            msg.get("events") or (),
+                            off if off is not None else 0.0,
+                        )
                 elif t in ("ok", "err"):
                     if self._pending is not None and self._pending[0] == msg.get("id"):
                         fut = self._pending[1]
@@ -769,12 +815,31 @@ class SupervisedEngine:
         self._down_noted = True
         if self._closing:
             return  # voluntary shutdown, not a fault
+        # flight recorder: every involuntary death — crash, hb stall,
+        # deadline kill, progress stall — lands here exactly once per
+        # incarnation, with the child's streamed spans already merged
+        self._flight_dump("child-death", reason)
         self.stats.deaths += 1
         self._backoff.next()  # arm the respawn delay
         if self._ladder_active:
             self.logger.warn(f"Engine host down: {reason} (recovery ladder active)")
             return
         self._breaker_count(reason)
+
+    def _flight_dump(self, slug: str, reason: str) -> None:
+        """Dump the merged trace ring next to the journal
+        (FISHNET_TPU_TRACE_DIR). Best-effort: forensics must never turn
+        a recoverable death into an unrecoverable one."""
+        rec = obs_trace.RECORDER
+        if rec is None or not self._trace_dir:
+            return
+        rec.instant("flight-dump", "supervisor", reason=reason)
+        try:
+            path = rec.flight_dump(self._trace_dir, slug)
+        except OSError as e:
+            self.logger.warn(f"Flight-recorder dump failed: {e}")
+        else:
+            self.logger.warn(f"Flight recorder: trace dumped to {path}")
 
     def _breaker_count(self, reason: str) -> None:
         """One breaker-window death; trips the breaker on the Nth within
@@ -785,6 +850,7 @@ class SupervisedEngine:
             self._deaths.popleft()
         if not self._breaker_open and len(self._deaths) >= self.breaker_threshold:
             self._breaker_open = True
+            self._flight_dump("breaker-trip", reason)
             self.stats.breaker_trips += 1
             self._next_probe = now + self.probe_interval
             self._deaths.clear()

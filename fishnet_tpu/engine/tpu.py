@@ -28,6 +28,8 @@ from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
 from ..ops import search as search_ops
 from ..ops.board import from_position, stack_boards
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..ops.search import INF, MATE, search_batch_resumable
 from ..utils import settings
 from ..utils.syncstats import SegmentController, SyncStats
@@ -1997,7 +1999,9 @@ class LaneScheduler:
                     shard_live = shard_occup()
                     disp_steps = seg
                     t0 = time.monotonic()
-                    state, tt, n, _summ = dispatch(state, tt, seg)
+                    with obs_trace.span("segment.dispatch", "engine",
+                                        steps=seg, live=live_n):
+                        state, tt, n, _summ = dispatch(state, tt, seg)
                     n_arr = np.asarray(
                         stats.fetch(n, "steps")
                     ).reshape(-1)
@@ -2070,7 +2074,9 @@ class LaneScheduler:
                         shard_occup(), adm_shard,
                     )
                     pend_steps = seg
-                    pend = dispatch(state, tt, seg)
+                    with obs_trace.span("segment.dispatch", "engine",
+                                        steps=seg):
+                        pend = dispatch(state, tt, seg)
                     tt = pend[1]
                 while pend is not None:
                     p_state, p_tt, _pn, p_summ = pend
@@ -2091,7 +2097,9 @@ class LaneScheduler:
                             shard_occup(), None,
                         )
                         nxt_steps = seg
-                        nxt = dispatch(p_state, p_tt, seg)
+                        with obs_trace.span("segment.dispatch", "engine",
+                                            steps=seg, speculative=True):
+                            nxt = dispatch(p_state, p_tt, seg)
                         tt = nxt[1]
                     summ, n, shard_steps = canon_summ(
                         stats.fetch(p_summ, "summary")
@@ -2166,7 +2174,9 @@ class LaneScheduler:
                         shard_occup(), adm_shard,
                     )
                     pend_steps = seg
-                    pend = dispatch(state, tt, seg)
+                    with obs_trace.span("segment.dispatch", "engine",
+                                        steps=seg):
+                        pend = dispatch(state, tt, seg)
                     tt = pend[1]
         except BaseException as e:
             # the driver died mid-session (device fault, OOM...): fail
@@ -2228,6 +2238,22 @@ class LaneScheduler:
         eng.occupancy_log.append(row)
         if len(eng.occupancy_log) > 4096:
             del eng.occupancy_log[:-4096]
+        rec = obs_trace.RECORDER
+        if rec is not None:
+            # lane-occupancy counter tracks render under the segment
+            # spans SyncStats.boundary() emitted for this interval
+            rec.counter("lanes.live", live, "engine")
+            rec.counter("lanes.helpers", helpers, "engine")
+            rec.counter("lanes.idle", idle, "engine")
+            rec.counter("queue.depth", queue, "engine")
+        # mirror the scheduler's ad-hoc totals into the metrics registry
+        # (boundary-rate, not step-rate: a handful of locked updates per
+        # segment, invisible next to a single device fetch)
+        reg = obs_metrics.REGISTRY
+        reg.absorb_totals("fishnet_occupancy", tot)
+        reg.gauge("fishnet_lanes_live").set(live)
+        reg.gauge("fishnet_queue_depth").set(queue)
+        reg.histogram("fishnet_boundary_host_ms").observe(host_ms)
         if eng.trace:
             eng.trace(
                 f"refill seg={tot['segments']} steps={steps} "

@@ -63,6 +63,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # importing run_lint's rule modules registers the families
         from . import concurrency_rules  # noqa: F401
         from . import config_rules  # noqa: F401
+        from . import obs_rules  # noqa: F401
         from . import trace_rules  # noqa: F401
         from . import wire_rules  # noqa: F401
 

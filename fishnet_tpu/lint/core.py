@@ -185,6 +185,7 @@ def run_lint(
     # rule modules self-register on import
     from . import concurrency_rules  # noqa: F401
     from . import config_rules  # noqa: F401
+    from . import obs_rules  # noqa: F401
     from . import trace_rules  # noqa: F401
     from . import wire_rules  # noqa: F401
 

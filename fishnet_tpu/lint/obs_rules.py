@@ -1,0 +1,71 @@
+"""Observability clock-discipline rules.
+
+The trace timeline (obs/trace.py), PhaseTracker heartbeats, and every
+duration in the hang-forensics path run on `time.monotonic()`. A single
+`time.time()` subtraction mixed in silently breaks that contract: an NTP
+step mid-run folds the timeline over itself, and the 290 us/step-class
+measurements the ROADMAP's perf items depend on become unreproducible.
+
+Rules:
+  obs-wall-clock   any `time.time()` call in a file under fishnet_tpu/.
+                   Durations and intervals must use time.monotonic() (or
+                   the trace clock, obs/trace.py now_us). The sanctioned
+                   exception — REPORT timestamps that must correlate
+                   with external logs/dashboards (e.g. the sqlite sink's
+                   row timestamps in client/stats.py) — is marked inline:
+                   `# fishnet-lint: disable=obs-wall-clock`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project, SourceFile, dotted, register_family
+
+
+def _time_call_sites(src: SourceFile) -> List[ast.Call]:
+    """Every call that resolves to stdlib time.time() in this file:
+    `time.time()` through `import time` (or an alias), and bare
+    `time()` through `from time import time` (or an alias)."""
+    mod_aliases: Set[str] = {"time"}
+    bare_names: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mod_aliases.add(alias.asname or "time")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time" and not node.level:
+                for alias in node.names:
+                    if alias.name == "time":
+                        bare_names.add(alias.asname or "time")
+
+    sites: List[ast.Call] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "time":
+            if dotted(fn.value) in mod_aliases:
+                sites.append(node)
+        elif isinstance(fn, ast.Name) and fn.id in bare_names:
+            sites.append(node)
+    return sites
+
+
+@register_family("obs")
+def check_obs_clock(project: Project) -> List[Finding]:
+    """Clock discipline: wall clock never measures durations."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu"):
+        for node in _time_call_sites(src):
+            findings.append(src.finding(
+                "obs-wall-clock", node,
+                "time.time() is wall clock — an NTP step skews every "
+                "duration and hang timeline derived from it; use "
+                "time.monotonic() (or the trace clock, obs/trace.py). "
+                "Report-timestamp sites that must match external logs "
+                "suppress inline with "
+                "`# fishnet-lint: disable=obs-wall-clock`",
+            ))
+    return findings

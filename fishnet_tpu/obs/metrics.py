@@ -1,0 +1,308 @@
+"""Counter/gauge/histogram registry with a Prometheus text endpoint.
+
+One interface absorbing the ad-hoc counter piles that grew per
+subsystem — SupervisorStats (engine/supervisor.py), SyncStats totals
+(utils/syncstats.py), LaneScheduler occupancy totals (engine/tpu.py) —
+so a single scrape (or one sqlite row via client/stats.py) sees the
+whole stack. Two consumers:
+
+- an opt-in stdlib-http endpoint serving Prometheus text exposition
+  format 0.0.4 (FISHNET_TPU_METRICS_PORT; off by default, binds
+  loopback only);
+- `snapshot()`, a flat name→value dict the client folds into the
+  existing sqlite StatsRecorder time series.
+
+Pure stdlib, no JAX/numpy at module scope (same constraint as
+obs/trace.py). All mutators take the registry lock — metrics are
+updated at segment boundaries and summary ticks, never inside the
+device hot loop, so a plain Lock is cheap enough.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "serve",
+    "serve_from_settings",
+]
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Milliseconds-oriented default buckets: segment boundaries run ~0.1 ms
+# (CPU smoke) to seconds (cold compile); powers of ~2.5 cover the range
+# in few buckets.
+DEFAULT_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def _sanitize(name: str) -> str:
+    out = _NAME_OK.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+class Counter:
+    """Monotonically non-decreasing count."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += n
+
+    def set_total(self, total: float) -> None:
+        """Absorb an externally-kept running total (SupervisorStats and
+        occupancy totals keep their own counters; the registry mirrors
+        them). Never moves backwards."""
+        with self._lock:
+            if total > self._value:
+                self._value = total
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> List[str]:
+        return [f"{self.name} {_fmt(self.value)}"]
+
+    def flatten(self) -> Dict[str, float]:
+        return {self.name: self.value}
+
+
+class Gauge:
+    """Point-in-time value (occupancy share, queue depth, offsets)."""
+
+    kind = "gauge"
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = "") -> None:
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> List[str]:
+        return [f"{self.name} {_fmt(self.value)}"]
+
+    def flatten(self) -> Dict[str, float]:
+        return {self.name: self.value}
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound, +Inf catches all)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts",
+                 "_sum", "_count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._lock = threading.Lock()
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self._counts[i] += 1
+                    break
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> List[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, sum_ = self._count, self._sum
+        out: List[str] = []
+        cum = 0
+        for ub, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{_fmt(ub)}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {_fmt(sum_)}")
+        out.append(f"{self.name}_count {total}")
+        return out
+
+    def flatten(self) -> Dict[str, float]:
+        return {
+            f"{self.name}_sum": self.sum,
+            f"{self.name}_count": float(self.count),
+        }
+
+
+def _fmt(v: float) -> str:
+    # Integral values render without the trailing ".0" Prometheus text
+    # tooling chokes on in le= labels.
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Get-or-create registry; creation is idempotent per (name, kind)
+    and a kind clash raises instead of silently shadowing."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, cls, name: str, help: str, **kwargs):
+        name = _sanitize(name)
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name} already registered as {m.kind}, "
+                    f"wanted {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Optional[Tuple[float, ...]] = None) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def absorb_totals(self, prefix: str, totals: Dict[str, float],
+                      kind: str = "counter") -> None:
+        """Mirror an externally-kept dict of running totals (e.g.
+        dataclasses.asdict(SupervisorStats), occupancy_totals) as
+        prefixed counters/gauges. Non-numeric values are skipped."""
+        for key, value in totals.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            name = f"{prefix}_{key}"
+            if kind == "counter":
+                self.counter(name).set_total(float(value))
+            else:
+                self.gauge(name).set(float(value))
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name→value view for the sqlite fold-in (histograms
+        flatten to _sum/_count)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, float] = {}
+        for m in metrics:
+            out.update(m.flatten())
+        return out
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# The process-wide default registry every subsystem feeds.
+REGISTRY = MetricsRegistry()
+
+
+def serve(port: int, registry: Optional[MetricsRegistry] = None):
+    """Start the /metrics endpoint on loopback in a daemon thread.
+
+    port > 0 binds that port; port == 0 binds an OS-assigned ephemeral
+    port (tests — read server.server_address[1]); port < 0 is off.
+    Returns the ThreadingHTTPServer, or None when off.
+    """
+    if port < 0:
+        return None
+    reg = registry if registry is not None else REGISTRY
+
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (stdlib handler API)
+            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            body = reg.render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt: str, *args) -> None:
+            pass  # scrapes must not spam the engine's stderr heartbeat
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="metrics-http"
+    )
+    thread.start()
+    return server
+
+
+def serve_from_settings(registry: Optional[MetricsRegistry] = None):
+    """Start the endpoint iff FISHNET_TPU_METRICS_PORT is a positive
+    port; the registry default 0 keeps it off."""
+    from ..utils import settings
+
+    port = settings.get_int("FISHNET_TPU_METRICS_PORT")
+    if port <= 0:
+        return None
+    return serve(port, registry)

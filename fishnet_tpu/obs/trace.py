@@ -1,0 +1,396 @@
+"""Thread-safe ring-buffer tracing on the monotonic clock.
+
+The recorder is a bounded deque of Chrome trace-event dicts (the JSON
+format Perfetto and chrome://tracing load natively): complete events
+("ph": "X") for spans, instants ("i") for point markers, counter samples
+("C") for time series. Timestamps are `time.monotonic()` in microseconds
+— never wall clock (lint rule obs-wall-clock): an NTP step must not be
+able to fold a hang timeline over itself.
+
+Cost model, in order of importance:
+
+1. Tracing OFF (default): `RECORDER` is None. Instrumentation sites do
+   `rec = trace.RECORDER` / `if rec is not None` — one attribute load
+   and one identity check, zero allocation. The module-level `span()`
+   helper returns a shared no-op context manager for the same price.
+2. Tracing ON: one small dict append per event into a
+   `collections.deque(maxlen=N)` — append and the implied eviction are
+   atomic under the GIL, so the hot path takes no lock. Only drain /
+   snapshot / export touch the lock-free deque in bulk.
+
+Cross-process story: the engine host child owns its own recorder and its
+ticker thread drains new events into `{"t": "trace", "events": [...]}`
+frames; the supervisor `absorb()`s them into the parent ring after
+shifting timestamps by the ClockSync offset. Because the parent holds
+the merged ring at all times, a SIGKILL'd child still leaves its spans
+in the flight-recorder dump — there is no end-of-life flush to lose.
+
+Keep this module pure stdlib (no JAX, no numpy): it is imported by
+conftest, fishnet-lint, and the engine host before JAX initializes.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "RECORDER",
+    "ClockSync",
+    "TraceRecorder",
+    "counter",
+    "install",
+    "install_from_settings",
+    "instant",
+    "now_us",
+    "span",
+    "uninstall",
+]
+
+# Module-global recorder. None means tracing is off; every
+# instrumentation site guards on exactly this:
+#     rec = trace.RECORDER
+#     if rec is not None: rec.instant(...)
+RECORDER: Optional["TraceRecorder"] = None
+
+
+def now_us() -> float:
+    """The trace clock: monotonic microseconds."""
+    return time.monotonic() * 1e6
+
+
+class _NullSpan:
+    """Shared no-op context manager returned by span() when tracing is
+    off — no allocation on the hot path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Context manager that emits one complete event on exit.
+
+    Exception-safe: the event is emitted whether or not the body raised,
+    and a raise annotates the event with the exception type (the span
+    still closes, so the timeline never shows a hole where an error
+    happened). The exception itself propagates unchanged.
+    """
+
+    __slots__ = ("_rec", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, rec: "TraceRecorder", name: str, cat: str,
+                 args: Optional[dict]) -> None:
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.monotonic()
+        args = self._args
+        if exc_type is not None:
+            args = dict(args) if args else {}
+            args["error"] = exc_type.__name__
+        self._rec.complete(
+            self._name,
+            self._t0 * 1e6,
+            (t1 - self._t0) * 1e6,
+            cat=self._cat,
+            args=args,
+        )
+        return False
+
+
+class TraceRecorder:
+    """Bounded ring of Chrome trace events, safe to append from any
+    thread. Oldest events fall off the back (deque maxlen), so the ring
+    always holds the *last* window of activity — exactly what a flight
+    recorder wants."""
+
+    def __init__(self, capacity: int = 65536,
+                 process_name: Optional[str] = None,
+                 pid: Optional[int] = None) -> None:
+        self.capacity = max(16, int(capacity))
+        self.pid = os.getpid() if pid is None else int(pid)
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity
+        )
+        self._meta_lock = threading.Lock()
+        self._process_names: Dict[int, str] = {}
+        self._thread_names: Dict[Tuple[int, int], str] = {}
+        self._dump_lock = threading.Lock()
+        # Approximate (unlocked) count of everything ever emitted;
+        # emitted - len(ring) estimates eviction for trace_report.
+        self.emitted = 0
+        if process_name:
+            self.set_process_name(process_name)
+
+    # -------------------------------------------------------- identity
+
+    def set_process_name(self, name: str, pid: Optional[int] = None) -> None:
+        with self._meta_lock:
+            self._process_names[self.pid if pid is None else pid] = name
+
+    def set_thread_name(self, name: str, tid: Optional[int] = None) -> None:
+        with self._meta_lock:
+            key = (self.pid, self._tid() if tid is None else tid)
+            self._thread_names[key] = name
+
+    @staticmethod
+    def _tid() -> int:
+        # Mask to 32 bits: CPython thread idents are pointer-sized and
+        # make Perfetto's track labels unreadable at full width.
+        return threading.get_ident() & 0xFFFFFFFF
+
+    # ------------------------------------------------------------ emit
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 cat: str = "app", args: Optional[dict] = None,
+                 tid: Optional[int] = None) -> None:
+        """One complete event ("X") with explicit start/duration — used
+        both by _Span on exit and by retroactive emitters (SyncStats
+        boundary accounting describes an interval that already ended)."""
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": ts_us,
+            "dur": max(dur_us, 0.0),
+            "pid": self.pid,
+            "tid": self._tid() if tid is None else tid,
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        self.emitted += 1
+
+    def span(self, name: str, cat: str = "app", **args) -> _Span:
+        return _Span(self, name, cat, args or None)
+
+    def instant(self, name: str, cat: str = "app", **args) -> None:
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "t",
+            "ts": now_us(),
+            "pid": self.pid,
+            "tid": self._tid(),
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        self.emitted += 1
+
+    def counter(self, name: str, value: float, cat: str = "app") -> None:
+        self._events.append({
+            "name": name,
+            "cat": cat,
+            "ph": "C",
+            "ts": now_us(),
+            "pid": self.pid,
+            "tid": 0,
+            "args": {"value": value},
+        })
+        self.emitted += 1
+
+    # ------------------------------------------------- cross-process IO
+
+    def drain(self) -> List[dict]:
+        """Pop every currently-buffered event (oldest first). The child
+        ticker calls this to stream increments to the supervisor; each
+        event leaves the ring exactly once."""
+        out: List[dict] = []
+        pop = self._events.popleft
+        try:
+            while True:
+                out.append(pop())
+        except IndexError:
+            pass
+        return out
+
+    def absorb(self, events: Iterable[dict],
+               offset_us: float = 0.0) -> int:
+        """Merge foreign events (a child's drained increment) into this
+        ring, shifting their timestamps by offset_us — the ClockSync
+        estimate mapping the child's monotonic clock onto ours."""
+        n = 0
+        for ev in events:
+            if not isinstance(ev, dict) or "ph" not in ev:
+                continue
+            ev = dict(ev)
+            try:
+                ev["ts"] = float(ev.get("ts", 0.0)) + offset_us
+            except (TypeError, ValueError):
+                continue
+            self._events.append(ev)
+            self.emitted += 1
+            n += 1
+        return n
+
+    # ---------------------------------------------------------- export
+
+    def snapshot(self, window_s: Optional[float] = None) -> List[dict]:
+        """Copy of the ring (non-destructive), optionally clipped to the
+        trailing window_s seconds of trace time."""
+        evs = list(self._events)
+        if window_s is not None:
+            cutoff = now_us() - window_s * 1e6
+            evs = [
+                e for e in evs
+                if float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+                >= cutoff
+            ]
+        return evs
+
+    def _metadata_events(self) -> List[dict]:
+        with self._meta_lock:
+            procs = dict(self._process_names)
+            threads = dict(self._thread_names)
+        out: List[dict] = []
+        for pid, name in sorted(procs.items()):
+            out.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": name},
+            })
+        for (pid, tid), name in sorted(threads.items()):
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": name},
+            })
+        return out
+
+    def export(self, window_s: Optional[float] = None) -> dict:
+        """The Chrome trace-event JSON object — load the dumped file
+        straight into Perfetto / chrome://tracing."""
+        evs = self.snapshot(window_s)
+        evs.sort(key=lambda e: float(e.get("ts", 0.0)))
+        return {
+            "traceEvents": self._metadata_events() + evs,
+            "displayTimeUnit": "ms",
+        }
+
+    def dump(self, path: str, window_s: Optional[float] = None) -> str:
+        """Write the export atomically (tmp + rename): a watcher tailing
+        the trace dir never reads a half-written JSON."""
+        with self._dump_lock:
+            tmp = f"{path}.tmp.{self.pid}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.export(window_s), fh)
+            os.replace(tmp, path)
+        return path
+
+    def flight_dump(self, dir_path: str, reason: str,
+                    window_s: Optional[float] = None) -> str:
+        """The flight-recorder write: dump the trailing window into
+        dir_path with a self-describing, collision-free name. Called by
+        the supervisor's recovery ladder next to its journal."""
+        os.makedirs(dir_path, exist_ok=True)
+        safe = "".join(
+            c if (c.isalnum() or c in "-_") else "-" for c in reason
+        )
+        stamp = time.strftime("%Y%m%dT%H%M%S")
+        base = f"trace-{safe}-{stamp}-pid{self.pid}"
+        path = os.path.join(dir_path, base + ".json")
+        n = 1
+        while os.path.exists(path):
+            path = os.path.join(dir_path, f"{base}-{n}.json")
+            n += 1
+        return self.dump(path, window_s)
+
+
+class ClockSync:
+    """Child-monotonic → parent-monotonic offset estimator.
+
+    time.monotonic() has an arbitrary per-process epoch, so child event
+    timestamps mean nothing on the parent timeline until shifted. Each
+    sample pairs a child reading (the "mono" field the host puts in its
+    ready and hb frames) with the parent's receive time:
+
+        offset = parent_recv_mono - child_mono
+
+    overestimates the true epoch difference by exactly the one-way
+    pipe+scheduling latency, which is strictly positive — so the MINIMUM
+    over samples is the best available estimate, it can only improve as
+    heartbeats keep arriving, and one quiet-moment frame pins it tight.
+    Estimated from the ready frame at config time, re-checked on every
+    heartbeat (supervisor._read_loop).
+    """
+
+    def __init__(self) -> None:
+        self.offset_us: Optional[float] = None
+        self.samples = 0
+
+    def sample(self, child_mono_s: float,
+               parent_recv_mono_s: float) -> float:
+        off = (parent_recv_mono_s - child_mono_s) * 1e6
+        if self.offset_us is None or off < self.offset_us:
+            self.offset_us = off
+        self.samples += 1
+        return self.offset_us
+
+
+# ------------------------------------------------- module-level helpers
+#
+# Convenience wrappers for non-hot-path call sites; all are free when
+# tracing is off. Hot loops should hoist `rec = trace.RECORDER` instead.
+
+
+def span(name: str, cat: str = "app", **args):
+    rec = RECORDER
+    if rec is None:
+        return NULL_SPAN
+    return rec.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "app", **args) -> None:
+    rec = RECORDER
+    if rec is not None:
+        rec.instant(name, cat, **args)
+
+
+def counter(name: str, value: float, cat: str = "app") -> None:
+    rec = RECORDER
+    if rec is not None:
+        rec.counter(name, value, cat)
+
+
+def install(recorder: TraceRecorder) -> TraceRecorder:
+    global RECORDER
+    RECORDER = recorder
+    return recorder
+
+
+def uninstall() -> None:
+    global RECORDER
+    RECORDER = None
+
+
+def install_from_settings(process_name: str) -> Optional[TraceRecorder]:
+    """Install the module-global recorder iff FISHNET_TPU_TRACE_DIR is
+    set (tracing's single opt-in switch); ring size from
+    FISHNET_TPU_TRACE_BUF. Returns the recorder, or None when tracing
+    stays off."""
+    from ..utils import settings
+
+    trace_dir = settings.get_str("FISHNET_TPU_TRACE_DIR")
+    if not trace_dir:
+        return None
+    capacity = settings.get_int("FISHNET_TPU_TRACE_BUF")
+    return install(TraceRecorder(capacity=capacity,
+                                 process_name=process_name))

@@ -25,9 +25,12 @@ from typing import Optional, TextIO
 
 def stamp(t0: float, msg: str, tag: str = "hb", file: Optional[TextIO] = None) -> None:
     """One timestamped heartbeat line on stderr (flushed immediately: the
-    tail must survive a hard kill)."""
+    tail must survive a hard kill). t0 is a `time.monotonic()` reading —
+    same clock as PhaseTracker and the trace timeline, so an NTP step
+    can't skew a hang forensics log (wall clock would; lint rule
+    obs-wall-clock)."""
     print(
-        f"[{tag} {time.time() - t0:7.1f}s] {msg}",
+        f"[{tag} {time.monotonic() - t0:7.1f}s] {msg}",
         file=file or sys.stderr,
         flush=True,
     )

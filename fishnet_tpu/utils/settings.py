@@ -245,6 +245,33 @@ SETTINGS: Tuple[Setting, ...] = (
             "(localize compile-vs-run cost from logs).",
     ),
     Setting(
+        name="FISHNET_TPU_TRACE_DIR",
+        kind="str",
+        default="",
+        doc="Enable the trace timeline (obs/trace.py) and write flight-"
+            "recorder dumps into this directory on child death, progress "
+            "stall, or breaker trip; unset keeps tracing off (the "
+            "default: one attribute check per site, zero events).",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_TRACE_BUF",
+        kind="int",
+        default="65536",
+        doc="Trace ring-buffer capacity in events (obs/trace.py); the "
+            "ring keeps the most recent events, so this bounds how far "
+            "back a flight-recorder dump can see.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_METRICS_PORT",
+        kind="int",
+        default="0",
+        doc="Serve the metrics registry (obs/metrics.py) as Prometheus "
+            "text on this loopback port; 0 (default) disables the "
+            "endpoint.",
+    ),
+    Setting(
         name="FISHNET_TPU_COMPILE_CACHE",
         kind="str",
         default="",

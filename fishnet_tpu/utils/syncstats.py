@@ -33,6 +33,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import trace as _trace
+
 
 class SyncStats:
     """Per-segment transfer and blocked-time accounting.
@@ -61,6 +63,12 @@ class SyncStats:
         t0 = time.monotonic()
         arr = np.asarray(value)
         dt_ms = (time.monotonic() - t0) * 1000.0
+        rec = _trace.RECORDER
+        if rec is not None:
+            rec.complete(
+                "fetch", t0 * 1e6, dt_ms * 1000.0, cat="sync",
+                args={"label": label, "elements": int(arr.size)},
+            )
         self._seg_transfers += 1
         self._seg_elements += int(arr.size)
         self._seg_blocked_ms += dt_ms
@@ -87,6 +95,21 @@ class SyncStats:
             "device_ms": round(self._seg_blocked_ms, 3),
             "host_ms": round(max(wall_ms - self._seg_blocked_ms, 0.0), 3),
         }
+        rec = _trace.RECORDER
+        if rec is not None:
+            # One "segment" span covering the whole boundary interval,
+            # with the device/host split as child spans whose durations
+            # are EXACTLY the snapshot's device_ms/host_ms — so
+            # tools/trace_report.py's per-segment shares tie out against
+            # SyncStats totals by construction, not by re-measurement.
+            start_us = self._seg_start * 1e6
+            rec.complete("segment", start_us, wall_ms * 1000.0,
+                         cat="sync", args=dict(snap))
+            rec.complete("segment.device", start_us,
+                         snap["device_ms"] * 1000.0, cat="sync")
+            rec.complete("segment.host",
+                         start_us + snap["device_ms"] * 1000.0,
+                         snap["host_ms"] * 1000.0, cat="sync")
         self.segments_total += 1
         self._seg_transfers = 0
         self._seg_elements = 0

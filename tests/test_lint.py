@@ -562,6 +562,94 @@ def test_journal_rule_scope_is_supervisor_only(tmp_path):
     assert by_rule(result.findings, "conc-journal-writer") == []
 
 
+# ------------------------------------------------------------------- obs
+
+
+OBS_BAD = '''
+import time
+import time as clock
+from time import time as wall
+
+
+def durations():
+    t0 = time.time()           # obs-wall-clock
+    t1 = clock.time()          # obs-wall-clock (aliased module)
+    t2 = wall()                # obs-wall-clock (from-import alias)
+    return t0, t1, t2
+'''
+
+OBS_CLEAN = '''
+import time
+
+
+def durations():
+    t0 = time.monotonic()
+    t1 = time.perf_counter()
+    stamp = time.strftime("%Y%m%dT%H%M%S")
+    return t0, t1, stamp
+'''
+
+
+def test_wall_clock_flagged_through_every_import_form(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/utils/bad.py": OBS_BAD}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-wall-clock")
+    assert len(found) == 3
+    assert all("monotonic" in f.message for f in found)
+
+
+def test_monotonic_and_strftime_are_clean(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/utils/ok.py": OBS_CLEAN}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-wall-clock") == []
+
+
+def test_wall_clock_scope_is_package_only(tmp_path):
+    # report timestamps in tools/ and tests/ are out of scope — only the
+    # package's timelines carry the clock-discipline contract
+    project = make_project(tmp_path, {
+        "tools/report.py": OBS_BAD,
+        "tests/test_x.py": OBS_BAD,
+    })
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-wall-clock") == []
+
+
+def test_wall_clock_suppressible_for_report_timestamps(tmp_path):
+    src = '''
+import time
+
+
+def report_row():
+    # correlates with external dashboards, sanctioned wall-clock read
+    ts = int(time.time())  # fishnet-lint: disable=obs-wall-clock
+    return ts
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/client/sink.py": src}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert result.findings == []
+
+
+def test_mutated_heartbeat_is_caught(tmp_path):
+    """Mutation test: regress the real heartbeat module back to wall
+    clock (the exact careless edit the rule exists for) and assert the
+    lint catches it."""
+    real = (REPO_ROOT / "fishnet_tpu/utils/heartbeat.py").read_text()
+    assert "time.monotonic()" in real  # the fixed form ships
+    broken = real.replace("time.monotonic()", "time.time()")
+    project = make_project(
+        tmp_path, {"fishnet_tpu/utils/heartbeat.py": broken}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-wall-clock")
+
+
 # ------------------------------------------- suppressions, baseline, CLI
 
 

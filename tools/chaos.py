@@ -275,6 +275,84 @@ async def scenario(args) -> int:
     return 0
 
 
+async def trace_smoke(args) -> int:
+    """CI flight-recorder smoke (ISSUE 10): a chaos-induced child death
+    with tracing on must leave a merged supervisor+host dump that loads
+    as valid Chrome trace JSON and passes trace_report's internal
+    cross-validation. Fails the step when no dump appears or the dump
+    does not parse."""
+    import os
+
+    from fishnet_tpu.obs import trace as obs_trace
+    from tools import trace_report
+
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="chaos-trace-") as tmp:
+        trace_dir = f"{tmp}/traces"
+        # set before the supervisor constructs: its __init__ reads the
+        # settings registry and installs the process-global recorder
+        os.environ["FISHNET_TPU_TRACE_DIR"] = trace_dir
+        print("== trace smoke: kill after 2 partials, tracing on ==")
+        sup = _scenario_supervisor(
+            json.dumps({"chunks": ["die-after:2", "partial-ok"]}),
+            f"{tmp}/s.json",
+        )
+        # --trace-skew 0.0 opts the fake host into streaming a synthetic
+        # child trace ring, so the dump exercises the cross-process merge
+        sup.host_cmd += ["--trace-skew", "0.0"]
+        try:
+            responses = await sup.go_multiple(make_chunk(1, 30.0, 4))
+            _check_exactly_once(responses, 4, problems, "trace-smoke")
+        except EngineError as e:
+            problems.append(f"trace-smoke: chunk failed outright: {e}")
+        finally:
+            print_stats(sup.stats)
+            await sup.close()
+        obs_trace.uninstall()
+        del os.environ["FISHNET_TPU_TRACE_DIR"]
+
+        dumps = sorted(Path(trace_dir).glob("trace-child-death-*.json"))
+        if not dumps:
+            problems.append(
+                "trace-smoke: child death left no flight dump in "
+                f"{trace_dir}"
+            )
+        else:
+            print(f"\nflight dump: {dumps[-1].name}")
+            rc = trace_report.main(
+                [str(dumps[-1]), "--selftest", f"--format={args.format}"]
+            )
+            if rc != 0:
+                problems.append(
+                    f"trace-smoke: trace_report exited {rc} on the dump"
+                )
+            else:
+                events = trace_report.load_events(str(dumps[-1]))
+                names = {e.get("name") for e in events}
+                # supervisor-side markers (spawn, the dump's own ladder
+                # instant) AND the child's streamed span must both be in
+                # the merged ring — the dump is written mid-recovery, so
+                # the still-open dispatch span is legitimately absent
+                for expected in ("spawn", "flight-dump", "fake.search"):
+                    if expected not in names:
+                        problems.append(
+                            f"trace-smoke: merged dump is missing "
+                            f"{expected!r} — supervisor and host "
+                            "timelines did not both land"
+                        )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos trace smoke::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos trace smoke: flight dump written, merged, and parsed")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos", description=__doc__,
@@ -300,6 +378,9 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", action="store_true",
                    help="run the session-recovery acceptance ladder and "
                         "exit non-zero on any delivery violation")
+    p.add_argument("--trace-smoke", action="store_true",
+                   help="kill a child mid-chunk with tracing on and "
+                        "verify the merged flight dump parses")
     p.add_argument("--format", choices=["text", "github"], default="text",
                    help="github emits ::error annotations for CI")
     args = p.parse_args(argv)
@@ -309,6 +390,8 @@ def main(argv=None) -> int:
         return 0
     if args.scenario:
         return asyncio.run(scenario(args))
+    if args.trace_smoke:
+        return asyncio.run(trace_smoke(args))
     return asyncio.run(replay(args))
 
 

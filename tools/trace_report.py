@@ -1,0 +1,252 @@
+"""Summarize a trace dump (obs/trace.py Chrome trace-event JSON).
+
+A flight-recorder dump (engine/supervisor.py writes one into
+FISHNET_TPU_TRACE_DIR on child death, progress stall, or breaker trip)
+or any TraceRecorder.dump() file holds the merged supervisor+host
+timeline. This tool turns it into the two summaries the ROADMAP's
+measurement items need without opening Perfetto:
+
+- **per-phase time shares**: total duration per span name (warmup,
+  search, supervisor.dispatch, queue.acquire, segment, ...) with the
+  SyncStats-derived device/host split (`segment.device` /
+  `segment.host` child spans) called out as a share of segment time —
+  the profiling lever for the ~290 us/step fixed per-segment gap.
+- **boundary-gap histogram**: the distribution of gaps between
+  consecutive `segment` spans on the host timeline — the fixed
+  per-boundary cost itself, bucketed.
+
+Cross-validation: every `segment` span carries its SyncStats snapshot
+in args (device_ms/host_ms), and its child spans' durations are those
+exact numbers — so `aggregate(args)` and `aggregate(child spans)` must
+agree to well under 1%; `--selftest` (and tests/test_trace.py) assert
+that.
+
+Usage:
+  python tools/trace_report.py TRACE.json
+  python tools/trace_report.py TRACE.json --format=github   # CI step
+  python tools/trace_report.py TRACE.json --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+# gap buckets in milliseconds (upper bounds; the last is open-ended)
+GAP_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 50.0, 250.0)
+
+
+def load_events(path: str) -> List[dict]:
+    """Load and minimally validate a Chrome trace-event file. Raises
+    ValueError on anything Perfetto would reject outright."""
+    with open(path, "r", encoding="utf-8") as fh:
+        obj = json.load(fh)
+    if isinstance(obj, list):
+        events = obj  # bare-array form is also valid Chrome trace JSON
+    elif isinstance(obj, dict) and isinstance(obj.get("traceEvents"), list):
+        events = obj["traceEvents"]
+    else:
+        raise ValueError(f"{path}: not a Chrome trace-event file")
+    out = []
+    for ev in events:
+        if not isinstance(ev, dict) or "ph" not in ev:
+            raise ValueError(f"{path}: malformed trace event: {ev!r}")
+        out.append(ev)
+    return out
+
+
+def _spans(events: List[dict], name: Optional[str] = None) -> List[dict]:
+    return [
+        e for e in events
+        if e.get("ph") == "X" and (name is None or e.get("name") == name)
+    ]
+
+
+def summarize(events: List[dict]) -> dict:
+    """The report dict: phase shares, segment split, boundary gaps."""
+    spans = _spans(events)
+    per_name: Dict[str, dict] = defaultdict(
+        lambda: {"count": 0, "total_ms": 0.0}
+    )
+    for e in spans:
+        row = per_name[str(e.get("name"))]
+        row["count"] += 1
+        row["total_ms"] += float(e.get("dur", 0.0)) / 1000.0
+
+    # SyncStats cross-validation: args-carried totals vs child-span sums
+    seg = _spans(events, "segment")
+    args_device = sum(
+        float((e.get("args") or {}).get("device_ms", 0.0)) for e in seg
+    )
+    args_host = sum(
+        float((e.get("args") or {}).get("host_ms", 0.0)) for e in seg
+    )
+    span_device = per_name.get("segment.device", {}).get("total_ms", 0.0)
+    span_host = per_name.get("segment.host", {}).get("total_ms", 0.0)
+
+    # boundary gaps: start-to-start minus duration of consecutive
+    # segment spans per (pid, tid) track, i.e. time between the end of
+    # one boundary window and the start of the next
+    gaps_ms: List[float] = []
+    by_track: Dict[tuple, List[dict]] = defaultdict(list)
+    for e in seg:
+        by_track[(e.get("pid"), e.get("tid"))].append(e)
+    for track in by_track.values():
+        track.sort(key=lambda e: float(e.get("ts", 0.0)))
+        for prev, cur in zip(track, track[1:]):
+            gap = (
+                float(cur.get("ts", 0.0))
+                - float(prev.get("ts", 0.0))
+                - float(prev.get("dur", 0.0))
+            ) / 1000.0
+            if gap >= 0.0:
+                gaps_ms.append(gap)
+    hist = [0] * (len(GAP_BUCKETS_MS) + 1)
+    for g in gaps_ms:
+        for i, ub in enumerate(GAP_BUCKETS_MS):
+            if g <= ub:
+                hist[i] += 1
+                break
+        else:
+            hist[-1] += 1
+
+    total_ms = sum(row["total_ms"] for row in per_name.values())
+    seg_total = span_device + span_host
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "phases": {
+            name: {
+                "count": row["count"],
+                "total_ms": round(row["total_ms"], 3),
+                "share": round(row["total_ms"] / total_ms, 4)
+                if total_ms > 0 else 0.0,
+            }
+            for name, row in sorted(
+                per_name.items(), key=lambda kv: -kv[1]["total_ms"]
+            )
+        },
+        "segments": {
+            "count": len(seg),
+            "device_ms": round(span_device, 3),
+            "host_ms": round(span_host, 3),
+            "device_share": round(span_device / seg_total, 4)
+            if seg_total > 0 else 0.0,
+            "host_share": round(span_host / seg_total, 4)
+            if seg_total > 0 else 0.0,
+            # the args-carried SyncStats totals, for cross-validation
+            "args_device_ms": round(args_device, 3),
+            "args_host_ms": round(args_host, 3),
+        },
+        "boundary_gaps": {
+            "count": len(gaps_ms),
+            "buckets_ms": list(GAP_BUCKETS_MS),
+            "histogram": hist,
+            "max_ms": round(max(gaps_ms), 3) if gaps_ms else 0.0,
+        },
+    }
+
+
+def crosscheck(report: dict, tolerance: float = 0.01) -> List[str]:
+    """The <=1% agreement contract between SyncStats args and the child
+    spans rendered from them. Returns human-readable violations."""
+    seg = report["segments"]
+    out = []
+    for key in ("device", "host"):
+        spans_ms = seg[f"{key}_ms"]
+        args_ms = seg[f"args_{key}_ms"]
+        ref = max(abs(args_ms), 1e-9)
+        if abs(spans_ms - args_ms) / ref > tolerance:
+            out.append(
+                f"segment.{key} spans sum to {spans_ms:.3f}ms but SyncStats "
+                f"args carry {args_ms:.3f}ms (>{tolerance:.0%} apart)"
+            )
+    return out
+
+
+def render_text(report: dict) -> str:
+    lines = [
+        f"trace: {report['events']} events, {report['spans']} spans",
+        "",
+        f"{'phase':<24} {'count':>7} {'total_ms':>12} {'share':>7}",
+    ]
+    for name, row in report["phases"].items():
+        lines.append(
+            f"{name:<24} {row['count']:>7} {row['total_ms']:>12.3f} "
+            f"{row['share']:>6.1%}"
+        )
+    seg = report["segments"]
+    if seg["count"]:
+        lines += [
+            "",
+            f"segments: {seg['count']}  device {seg['device_ms']:.3f}ms "
+            f"({seg['device_share']:.1%})  host {seg['host_ms']:.3f}ms "
+            f"({seg['host_share']:.1%})",
+        ]
+    gaps = report["boundary_gaps"]
+    if gaps["count"]:
+        lines += ["", "boundary gaps (ms):"]
+        edges = ["0"] + [str(b) for b in gaps["buckets_ms"]]
+        for i, n in enumerate(gaps["histogram"]):
+            hi = edges[i + 1] if i < len(gaps["buckets_ms"]) else "inf"
+            lines.append(f"  ({edges[i] if i else '0'}, {hi}]: {n}")
+        lines.append(f"  max: {gaps['max_ms']:.3f}ms")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="trace-report")
+    parser.add_argument("trace", help="Chrome trace-event JSON file")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON")
+    parser.add_argument(
+        "--format", choices=["text", "github"], default="text",
+        help="github: workflow annotations + step summary lines",
+    )
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="fail unless SyncStats args and segment child spans agree "
+             "within 1%% (the dump's internal cross-validation)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        msg = f"unreadable trace {args.trace}: {e}"
+        if args.format == "github":
+            print(f"::error title=trace-report::{msg}")
+        else:
+            print(f"trace-report: {msg}", file=sys.stderr)
+        return 2
+
+    report = summarize(events)
+    violations = crosscheck(report) if args.selftest else []
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    elif args.format == "github":
+        seg = report["segments"]
+        print(
+            f"::notice title=trace-report::{args.trace}: "
+            f"{report['events']} events, {report['spans']} spans, "
+            f"{seg['count']} segments "
+            f"(device {seg['device_share']:.1%} / "
+            f"host {seg['host_share']:.1%})"
+        )
+        print(render_text(report))
+    else:
+        print(render_text(report))
+
+    for msg in violations:
+        if args.format == "github":
+            print(f"::error title=trace-report crosscheck::{msg}")
+        else:
+            print(f"trace-report: CROSSCHECK FAILED: {msg}", file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
