@@ -596,6 +596,140 @@ def run_stage(B: int, depth: int, budget: int, timeout: float,
     return None
 
 
+def run_serve_stage(timeout: float) -> dict | None:
+    """Closed-loop latency row for the HTTP serving front-end
+    (fishnet_tpu/serve/): boots `fishnet_tpu serve --backend python` as
+    a subprocess, drives it with closed-loop client threads (each sends
+    its next request the moment the previous one answers), and reports
+    request latency p50/p99, the shed (429) rate, and positions/s. The
+    python backend keeps the row measuring the serving layer itself —
+    admission, HTTP framing, session fan-in — not device search speed;
+    BENCH_SERVE_BACKEND overrides for an end-to-end device row."""
+    import http.client
+    import signal
+    import threading
+
+    backend = os.environ.get("BENCH_SERVE_BACKEND", "python")
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_SERVE_REQUESTS", "12"))
+    start_fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    t0 = time.monotonic()
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fishnet_tpu", "serve",
+         "--backend", backend, "--serve-port", "0", "--no-conf"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    try:
+        host_port = None
+        assert proc.stdout is not None
+        while time.monotonic() - t0 < min(timeout, 120.0):
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "serve: listening on " in line:
+                host_port = line.split("serve: listening on ", 1)[1].strip()
+                break
+        if host_port is None:
+            print("bench serve_latency: server never came up",
+                  file=sys.stderr, flush=True)
+            return None
+        host, _, port_s = host_port.rpartition(":")
+        port = int(port_s)
+        # drain the server's remaining stdout so it can't block on a
+        # full pipe while we measure
+        threading.Thread(
+            target=lambda: proc.stdout.read(), daemon=True
+        ).start()
+
+        lock = threading.Lock()
+        lat_ms: list = []
+        shed = [0]
+        failed = [0]
+        positions = [0]
+
+        def one_client(cid: int) -> None:
+            conn = http.client.HTTPConnection(host, port, timeout=60.0)
+            try:
+                for i in range(per_client):
+                    n_pos = 1 + (i % 2)
+                    body = json.dumps({
+                        "id": f"bench-{cid}-{i}",
+                        "tenant": f"bench{cid % 2}",
+                        # depth 1 keeps the python backend's share of
+                        # the latency in the low ms, so p50/p99 track
+                        # the serving layer rather than the fallback
+                        # engine's search speed
+                        "positions": [{"fen": start_fen, "moves": []}] * n_pos,
+                        "depth": 1,
+                        "timeout_ms": 30_000,
+                    })
+                    t1 = time.monotonic()
+                    try:
+                        conn.request("POST", "/analyse", body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                    except (OSError, ValueError, http.client.HTTPException):
+                        with lock:
+                            failed[0] += 1
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            host, port, timeout=60.0)
+                        continue
+                    dt_ms = (time.monotonic() - t1) * 1000.0
+                    with lock:
+                        if resp.status == 200:
+                            lat_ms.append(dt_ms)
+                            positions[0] += n_pos
+                        elif resp.status == 429:
+                            shed[0] += 1
+                        else:
+                            failed[0] += 1
+            finally:
+                conn.close()
+
+        t_load = time.monotonic()
+        threads = [threading.Thread(target=one_client, args=(cid,))
+                   for cid in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        wall_s = max(time.monotonic() - t_load, 1e-6)
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            print("bench serve_latency: server ignored SIGTERM",
+                  file=sys.stderr, flush=True)
+            return None
+        if not lat_ms:
+            print("bench serve_latency: no request completed",
+                  file=sys.stderr, flush=True)
+            return None
+        lat_ms.sort()
+        total = len(lat_ms) + shed[0] + failed[0]
+        return {
+            "backend": backend,
+            "clients": clients,
+            "requests_ok": len(lat_ms),
+            "p50_ms": round(lat_ms[len(lat_ms) // 2], 2),
+            "p99_ms": round(lat_ms[min(len(lat_ms) - 1,
+                                       (len(lat_ms) * 99) // 100)], 2),
+            "shed_rate": round(shed[0] / max(total, 1), 4),
+            "failed": failed[0],
+            "positions_per_s": round(positions[0] / wall_s, 1),
+        }
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
 def device_preflight(timeout: float = 120.0) -> bool:
     """Can a fresh process see the TPU at all? A wedged/down tunnel makes
     jax init hang, which would otherwise burn one full stage timeout per
@@ -771,6 +905,22 @@ def main() -> None:
             )
             matrix[name] = res
             print(f"bench config {name}: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # serving-layer latency row (round 11): host-side closed loop over
+    # the HTTP front-end; runs on the python backend so it measures
+    # admission + framing + session fan-in, independent of the device
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120.0:
+            print("bench: skipping serve_latency (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["serve_latency"] = None
+        else:
+            res = run_serve_stage(min(stage_timeout, remaining))
+            matrix["serve_latency"] = res
+            print("bench config serve_latency: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
     if matrix:

@@ -337,6 +337,13 @@ def main(argv=None) -> int:
             run_name="__main__",
         )
         return 0
+    if cfg.command == "serve":
+        # the analysis-serving front-end (fishnet_tpu/serve/): many
+        # concurrent HTTP tenants multiplex into the same lane pool the
+        # lichess client feeds
+        from ..serve.server import run_serve
+
+        return asyncio.run(run_serve(cfg))
     if cfg.command == "configure":
         return 0  # parse_and_configure already ran the dialog
     return asyncio.run(run(cfg))

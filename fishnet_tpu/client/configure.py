@@ -123,6 +123,10 @@ class Config:
     stats_file: Optional[str] = None
     no_stats_file: bool = False
     auto_update: bool = False
+    # `serve` subcommand (fishnet_tpu/serve/): bind overrides; None
+    # defers to the FISHNET_TPU_SERVE_HOST/_PORT registry settings
+    serve_host: Optional[str] = None
+    serve_port: Optional[int] = None
     conf: Optional[str] = None
     no_conf: bool = False
     verbose: int = 0
@@ -142,7 +146,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="Distributed analysis client for lichess.org with a TPU engine",
     )
     p.add_argument("command", nargs="?", default="run",
-                   choices=["run", "configure", "systemd", "systemd-user", "license", "bench"])
+                   choices=["run", "configure", "systemd", "systemd-user",
+                            "license", "bench", "serve"])
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--auto-update", action="store_true")
     p.add_argument("--conf", help="path to fishnet.ini")
@@ -179,6 +184,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-tpu-quarantine", action="store_true",
                    help="never quarantine isolated poison positions to "
                         "the CPU fallback")
+    p.add_argument("--serve-host",
+                   help="serve subcommand: bind address (default "
+                        "FISHNET_TPU_SERVE_HOST, loopback)")
+    p.add_argument("--serve-port", type=int,
+                   help="serve subcommand: TCP port; 0 binds an ephemeral "
+                        "port (default FISHNET_TPU_SERVE_PORT)")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")
     p.add_argument("--max-backoff", help="maximum backoff duration")
@@ -269,6 +280,9 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
         cfg.tpu_quarantine = True
     bisect_max = pick(args.tpu_bisect_max, "tpu_bisect_max")
     cfg.tpu_bisect_max = int(bisect_max) if bisect_max is not None else None
+    cfg.serve_host = pick(args.serve_host, "serve_host")
+    serve_port = pick(args.serve_port, "serve_port")
+    cfg.serve_port = int(serve_port) if serve_port is not None else None
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

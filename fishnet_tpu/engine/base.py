@@ -1,15 +1,28 @@
-"""Engine interface: everything behind `go_multiple(Chunk)`.
+"""Engine interface: chunk batches and position-level sessions.
 
-The reference keeps Stockfish subprocesses behind exactly this shape
-(reference: src/stockfish.rs:36-48 `StockfishStub::go_multiple`); here it is
-the seam between the client framework and the three backends (TPU batch
-engine, UCI subprocess, pure-Python fallback).
+The reference keeps Stockfish subprocesses behind exactly the
+`go_multiple` shape (reference: src/stockfish.rs:36-48
+`StockfishStub::go_multiple`); here it is the seam between the client
+framework and the backends (TPU batch engine, UCI subprocess,
+pure-Python fallback, supervised child host).
+
+Since the serving round the protocol also carries `submit()`: one
+position with its own deadline and priority, answered by one
+PositionResponse (engine/session.py `PositionRequest`). Frontends that
+hold positions rather than fishnet chunks — the HTTP server
+(fishnet_tpu/serve/), bench closed-loop clients — speak this surface;
+backends conform via the `ChunkSubmit` mixin (engine/session.py), which
+wraps a request as a one-position chunk, so every backend that can run
+a chunk can serve position traffic too.
 """
 from __future__ import annotations
 
-from typing import List, Protocol
+from typing import TYPE_CHECKING, List, Protocol
 
 from ..client.ipc import Chunk, PositionResponse
+
+if TYPE_CHECKING:  # circular at runtime: session.py builds Chunks
+    from .session import PositionRequest
 
 
 class EngineError(Exception):
@@ -20,6 +33,11 @@ class EngineError(Exception):
 class Engine(Protocol):
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
         """Analyse every position of the chunk, in order."""
+        ...
+
+    async def submit(self, request: "PositionRequest") -> PositionResponse:
+        """Analyse one position-level request (engine/session.py); the
+        deadline/priority ride the request instead of a chunk."""
         ...
 
     async def close(self) -> None:

@@ -15,7 +15,11 @@ A script is a JSON object:
 
 `boot[i]` is the startup behavior of the i-th host incarnation;
 `chunks[j]` the behavior for the j-th chunk EVER dispatched (counted
-across respawns). Lists are extended by repeating their last entry. The
+across respawns). Position-level `submit()` traffic (engine/session.py)
+reaches a fakehost child the same way chunks do: SupervisedEngine's
+ChunkSubmit conformance wraps the request as a one-position chunk and
+ships it over this pipe protocol, so serve-layer tests can script the
+fake host behind the HTTP front-end too. Lists are extended by repeating their last entry. The
 cross-incarnation counters persist in --state (a JSON file) — without
 it, every respawn would replay the script from the top and a
 crash-then-recover sequence could never be expressed.

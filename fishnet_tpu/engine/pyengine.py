@@ -20,6 +20,7 @@ from ..chess.types import BISHOP, KNIGHT, PAWN, QUEEN, ROOK
 from ..chess.variants import from_fen
 from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
 from ..client.wire import AnalysisWork, MoveWork, Score
+from .session import ChunkSubmit
 
 MATE_VALUE = 32000
 PIECE_VALUES = {PAWN: 100, KNIGHT: 300, BISHOP: 315, ROOK: 500, QUEEN: 900, 5: 0}
@@ -94,7 +95,7 @@ def _score_of(value: int, ply_base: int = 0) -> Score:
     return Score.cp(value)
 
 
-class PyEngine:
+class PyEngine(ChunkSubmit):
     """Analyses chunks synchronously on the executor."""
 
     def __init__(self, max_depth: int = 3, multipv_max: int = 5):
@@ -118,6 +119,7 @@ class PyEngine:
             pos = pos.push(pos.parse_uci(uci))
 
         work = chunk.work
+        move_deadline: Optional[float] = None
         if isinstance(work, AnalysisWork):
             target_depth = min(work.depth or self.max_depth, self.max_depth)
             multipv = min(work.effective_multipv(), self.multipv_max)
@@ -127,6 +129,10 @@ class PyEngine:
             target_depth = min(work.level.depth, self.max_depth)
             multipv = 1
             node_budget = None
+            # play jobs are time-budgeted, not node-budgeted: the skill
+            # table's movetime is the whole point of "play-speed" moves
+            # (the reference passes it to Stockfish as `go movetime`)
+            move_deadline = started + work.level.movetime_ms / 1000.0
 
         scores = Matrix()
         pvs = Matrix()
@@ -158,14 +164,27 @@ class PyEngine:
         root_scored: List[Tuple[int, str, List[str]]] = []
         try:
             for depth in range(1, target_depth + 1):
+                # depth 1 always completes so a move exists even on a
+                # 50 ms level-1 budget; deeper iterations only start or
+                # continue while the movetime budget allows
+                if move_deadline is not None and depth > 1 and \
+                        time.monotonic() >= move_deadline:
+                    break
                 moves = search._ordered_moves(pos)
                 depth_scored = []
+                aborted = False
                 for move in moves:
+                    if move_deadline is not None and depth > 1 and \
+                            time.monotonic() >= move_deadline:
+                        aborted = True  # discard the partial depth
+                        break
                     child = pos.push(move)
                     value, line = search.negamax(
                         child, depth - 1, -MATE_VALUE * 2, MATE_VALUE * 2, 1
                     )
                     depth_scored.append((-value, move.uci(), [move.uci()] + line))
+                if aborted:
+                    break
                 depth_scored.sort(key=lambda t: -t[0])
                 root_scored = depth_scored
                 reached_depth = depth

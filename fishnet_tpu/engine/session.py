@@ -1,0 +1,249 @@
+"""Position-level session interface over the chunk engines.
+
+The roadmap's refactor unlock (ROADMAP.md "New directions" #1): everything
+above the `LaneScheduler` used to assume exactly one upstream speaking the
+fishnet chunk protocol — `engine/tpu.py` routes all work through
+`go_multiple(Chunk)`. This module splits the session-driving core out from
+behind that protocol: a frontend holds `PositionRequest`s (one position, its
+own deadline and priority) and an `EngineSession` converts them into chunks
+and feeds whatever engine it wraps. Concurrent `submit()` calls against the
+TPU engine land in the `LaneScheduler`'s shared pending queue (any executor
+thread submitting a chunk joins the combining driver), so the lichess client
+(`client/workers.py`), the HTTP server (`fishnet_tpu/serve/`) and `bench.py`
+all feed the same lane pool — the scheduler's hardest-deadline-first
+admission orders their positions against each other by the per-request
+deadlines carried through here.
+
+The `submit()` surface is part of the `Engine` protocol (engine/base.py);
+`ChunkSubmit` below is the shared conformance mixin for chunk-native
+backends (PyEngine, UciEngine, TpuEngine, SupervisedEngine — the last
+covers the scripted fakehost child too, since it proxies chunks over the
+supervisor pipe protocol).
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..client.ipc import Chunk, PositionResponse, WorkPosition
+from ..client.wire import (
+    MAX_CHUNK_POSITIONS,
+    AnalysisWork,
+    EngineFlavor,
+    MoveWork,
+    NodeLimit,
+    SkillLevel,
+    Work,
+)
+
+# Priority tiers: interactive bestmove traffic outranks batch analysis at
+# the admission controller; within a tier, deadlines order the work
+# (hardest first — both in the serve waiting room and in the
+# LaneScheduler's pending queue).
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BATCH = 1
+
+# Default per-position node budget for served requests with no explicit
+# budget: the reference's production sf16/classical budgets
+# (src/api.rs:214-233 order of magnitude), pre-scaled up by 7/6 so
+# NodeLimit.get()'s chunk-overlap compensation lands back on round numbers.
+DEFAULT_NODES = NodeLimit(sf16=2_800_000, classical=5_040_000)
+
+DEFAULT_TIMEOUT_S = 8.0
+
+_batch_seq = itertools.count()
+
+
+@dataclass(frozen=True)
+class PositionRequest:
+    """One position submitted by any frontend.
+
+    deadline is a time.monotonic() timestamp (None: now + DEFAULT_TIMEOUT_S
+    at submission). priority is one of the PRIORITY_* tiers. kind is
+    "analysis" (scores/pvs matrices) or "bestmove" (play a move at a
+    lichess skill level).
+    """
+
+    fen: str
+    moves: Tuple[str, ...] = ()
+    variant: str = "standard"
+    kind: str = "analysis"
+    depth: Optional[int] = None
+    multipv: Optional[int] = None
+    nodes: Optional[int] = None
+    level: int = 8
+    deadline: Optional[float] = None
+    priority: int = PRIORITY_BATCH
+
+
+@dataclass(frozen=True)
+class _GroupKey:
+    """Requests sharing a group key are compatible with one Chunk: a chunk
+    carries exactly one Work and one deadline, and both shape the search."""
+
+    kind: str
+    variant: str
+    depth: Optional[int]
+    multipv: Optional[int]
+    nodes: Optional[int]
+    level: int
+    deadline: float
+
+
+def _work_for(key: _GroupKey, batch_id: str) -> Work:
+    if key.kind == "bestmove":
+        return MoveWork(id=batch_id, level=SkillLevel(key.level))
+    nodes = key.nodes
+    if nodes is None:
+        limit = DEFAULT_NODES
+    else:
+        # an explicit per-request budget applies as-is to either eval
+        # flavor; pre-scale so NodeLimit.get()'s overlap compensation
+        # cancels out and the engine sees exactly `nodes`
+        scaled = nodes * (MAX_CHUNK_POSITIONS + 1) // MAX_CHUNK_POSITIONS
+        limit = NodeLimit(sf16=scaled, classical=scaled)
+    return AnalysisWork(
+        id=batch_id,
+        nodes=limit,
+        timeout_s=7.0,
+        depth=key.depth,
+        multipv=key.multipv,
+    )
+
+
+def next_batch_id(prefix: str = "serve") -> str:
+    """Work ids are capped at 24 chars by the wire layer; a process-local
+    counter keeps them short and unique."""
+    return f"{prefix}{next(_batch_seq) % 10**8:08d}"
+
+
+def requests_to_chunks(
+    requests: Sequence[PositionRequest],
+    flavor: EngineFlavor = EngineFlavor.TPU,
+    id_prefix: str = "serve",
+    now: Optional[float] = None,
+) -> List[Tuple[Chunk, List[int]]]:
+    """Group compatible requests into chunks of <= MAX_CHUNK_POSITIONS.
+
+    Returns (chunk, request_indices) pairs; index i of the chunk's
+    positions (== position_index) answers requests[request_indices[i]].
+    Only requests with identical work shape AND deadline share a chunk —
+    the deadline cuts off the search, so merging deadlines would change
+    results vs. submitting each request alone.
+    """
+    if now is None:
+        now = time.monotonic()
+    groups: Dict[_GroupKey, List[int]] = {}
+    for i, req in enumerate(requests):
+        deadline = req.deadline
+        if deadline is None:
+            deadline = now + DEFAULT_TIMEOUT_S
+        key = _GroupKey(
+            kind=req.kind, variant=req.variant, depth=req.depth,
+            multipv=req.multipv, nodes=req.nodes, level=req.level,
+            deadline=deadline,
+        )
+        groups.setdefault(key, []).append(i)
+    out: List[Tuple[Chunk, List[int]]] = []
+    for key, indices in groups.items():
+        for lo in range(0, len(indices), MAX_CHUNK_POSITIONS):
+            part = indices[lo:lo + MAX_CHUNK_POSITIONS]
+            work = _work_for(key, next_batch_id(id_prefix))
+            positions = [
+                WorkPosition(
+                    work=work,
+                    position_index=slot,
+                    url=None,
+                    skip=False,
+                    root_fen=requests[i].fen,
+                    moves=list(requests[i].moves),
+                )
+                for slot, i in enumerate(part)
+            ]
+            chunk = Chunk(
+                work=work,
+                deadline=key.deadline,
+                variant=key.variant,
+                flavor=flavor,
+                positions=positions,
+            )
+            out.append((chunk, part))
+    return out
+
+
+class ChunkSubmit:
+    """Conformance mixin: `submit()` for any engine exposing
+    `go_multiple(Chunk)`. One request becomes a one-position chunk; the
+    TpuEngine's scheduler merges concurrent one-position chunks into the
+    shared lane pool, so per-request submission costs no batching there."""
+
+    _submit_flavor = EngineFlavor.TPU
+
+    async def submit(self, request: PositionRequest) -> PositionResponse:
+        (chunk, _indices), = requests_to_chunks(
+            [request], flavor=self._submit_flavor
+        )
+        responses = await self.go_multiple(chunk)
+        return responses[0]
+
+
+@dataclass
+class _SessionStats:
+    submitted: int = 0
+    completed: int = 0
+    failed: int = 0
+
+
+class EngineSession:
+    """Shared front door for position-level callers.
+
+    Owns nothing but the conversion: deadlines/priorities ride the
+    requests, chunks are built per compatible group, and the wrapped
+    engine's own concurrency model does the multiplexing (the TPU
+    engine's LaneScheduler pools every concurrent chunk's positions;
+    chunk-serial backends simply serialize). close() leaves the engine
+    alive — the session is one of possibly many tenants of it.
+    """
+
+    def __init__(self, engine, flavor: EngineFlavor = EngineFlavor.TPU,
+                 id_prefix: str = "serve"):
+        self.engine = engine
+        self.flavor = flavor
+        self.id_prefix = id_prefix
+        self.stats = _SessionStats()
+
+    async def submit(self, request: PositionRequest) -> PositionResponse:
+        results = await self.submit_many([request])
+        return results[0]
+
+    async def submit_many(
+        self, requests: Sequence[PositionRequest]
+    ) -> List[PositionResponse]:
+        """Submit a batch of requests; responses come back in request
+        order. Chunks run concurrently — against the TPU engine they
+        share one lane pool and finish as their positions finish."""
+        self.stats.submitted += len(requests)
+        plan = requests_to_chunks(
+            requests, flavor=self.flavor, id_prefix=self.id_prefix
+        )
+        out: List[Optional[PositionResponse]] = [None] * len(requests)
+
+        async def run(chunk: Chunk, indices: List[int]) -> None:
+            responses = await self.engine.go_multiple(chunk)
+            for slot, i in enumerate(indices):
+                out[i] = responses[slot]
+
+        try:
+            await asyncio.gather(*(run(c, idx) for c, idx in plan))
+        except Exception:
+            self.stats.failed += len(requests)
+            raise
+        assert all(r is not None for r in out)
+        self.stats.completed += len(requests)
+        return out  # type: ignore[return-value]
+
+    async def close(self) -> None:
+        pass

@@ -69,6 +69,7 @@ from ..obs import trace as obs_trace
 from ..utils import settings
 from .base import EngineError
 from .frames import FrameError, PipeClosed, encode, read_frame_async
+from .session import ChunkSubmit
 
 # the child must be able to `import fishnet_tpu` no matter where the
 # parent was launched from
@@ -148,7 +149,7 @@ def _consume_exc(fut: asyncio.Future) -> None:
         fut.exception()
 
 
-class SupervisedEngine:
+class SupervisedEngine(ChunkSubmit):
     """`Engine`-protocol proxy to a child engine host.
 
     Reusable after `close()` (the worker's drop-and-respawn pattern

@@ -34,6 +34,7 @@ from ..ops.search import INF, MATE, search_batch_resumable
 from ..utils import settings
 from ..utils.syncstats import SegmentController, SyncStats
 from .base import EngineError
+from .session import ChunkSubmit
 
 # static stack depth; supports search depths up to MAX_PLY-1, with the
 # tail past the nominal depth doubling as quiescence headroom (32 leaves
@@ -131,7 +132,7 @@ def _pad_lanes(n: int) -> int:
     return ((n + 255) // 256) * 256
 
 
-class TpuEngine:
+class TpuEngine(ChunkSubmit):
     """Batched analysis engine. `variants` lists what it accepts (the
     planner routes only those here — client/planner.py tpu_variants)."""
 

@@ -23,6 +23,7 @@ from ..client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
 from ..client.wire import AnalysisWork, EngineFlavor, MoveWork
 from ..client.wire import Score
 from .base import EngineError
+from .session import ChunkSubmit
 
 # lichess variant key → UCI_Variant value (reference: shakmaty Variant::uci)
 UCI_VARIANT_NAMES = {
@@ -39,7 +40,7 @@ UCI_VARIANT_NAMES = {
 }
 
 
-class UciEngine:
+class UciEngine(ChunkSubmit):
     def __init__(self, exe_path: str, logger=None, flavor: EngineFlavor = EngineFlavor.OFFICIAL):
         self.exe_path = exe_path
         self.logger = logger

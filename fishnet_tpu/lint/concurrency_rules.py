@@ -25,11 +25,20 @@ that true:
                        trusts exactly-once journal contents, so the
                        single-writer invariant allows mutation only in
                        _journal_record/_journal_reset/__init__
+  conc-sock-in-loop    a known-blocking socket/IO call (socket.*,
+                       time.sleep, urllib, http.client) inside an
+                       `async def` of the serving package — one blocked
+                       handler freezes every connection the event loop
+                       owns; use asyncio streams / asyncio.sleep /
+                       run_in_executor instead
 
 Scopes: the timeout/lock rules run on the process-boundary modules
-(supervisor, host, uci, workers, queue); the except rules run on all of
-client/ and engine/ (kernels and utils keep their own idioms — e.g.
-compile_cache deliberately degrades to "no cache" on any error).
+(supervisor, host, uci, workers, queue) and on fishnet_tpu/serve/ (the
+HTTP front-end is a process boundary too); the except rules run on all
+of client/, engine/ and serve/ (kernels and utils keep their own idioms
+— e.g. compile_cache deliberately degrades to "no cache" on any error).
+The sock-in-loop rule runs on serve/ only — the one package whose code
+lives inside a single shared event loop.
 Narrow handlers (`except OSError: pass` around best-effort logging) are
 deliberately not flagged — the rules target *broad* swallowing.
 
@@ -65,10 +74,28 @@ BLOCK_SCOPE = (
     "fishnet_tpu/engine/uci.py",
     "fishnet_tpu/client/workers.py",
     "fishnet_tpu/client/queue.py",
+    "fishnet_tpu/serve",
 )
 
 # modules where a swallowed exception hides an operational failure
-EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine")
+EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine",
+                "fishnet_tpu/serve")
+
+# the serving package runs inside ONE shared event loop: a blocking
+# socket call in an async def stalls every tenant at once
+SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve",)
+
+# call targets that block the thread: raw socket ops, sync HTTP
+# clients, and the sleep that should have been asyncio.sleep. Matched
+# against the dotted call name: exact for the module-level forms,
+# attribute-tail for the socket-object methods (asyncio stream APIs —
+# read/readline/readexactly/write/drain — are deliberately absent)
+_BLOCKING_IN_LOOP_EXACT = ("time.sleep", "socket.socket",
+                           "socket.create_connection", "socket.getaddrinfo",
+                           "urllib.request.urlopen")
+_BLOCKING_IN_LOOP_TAILS = ("accept", "connect", "recv", "recv_into",
+                           "sendall", "makefile", "urlopen",
+                           "HTTPConnection", "HTTPSConnection")
 
 # the scheduler loops: blocking host syncs here stall the segment
 # pipeline — engine/tpu.py holds the LaneScheduler, ops/search.py the
@@ -306,6 +333,40 @@ def _check_journal_writer(src, findings: List[Finding]) -> None:
             ))
 
 
+def _check_sock_in_loop(src, findings: List[Finding]) -> None:
+    """Blocking socket/IO calls inside an `async def`: the serving
+    package's handlers all share one event loop, so a single blocking
+    call freezes every connection. Sync helpers nested inside the async
+    function are skipped — they run under to_thread/run_in_executor by
+    construction (that's the sanctioned escape hatch)."""
+
+    def async_body_calls(fn: ast.AsyncFunctionDef):
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # sync helper / inner coroutine (walked on its own)
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    for fn in ast.walk(src.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for call in async_body_calls(fn):
+            target = dotted(call.func)
+            tail = target.split(".")[-1]
+            if target in _BLOCKING_IN_LOOP_EXACT or \
+                    tail in _BLOCKING_IN_LOOP_TAILS:
+                findings.append(src.finding(
+                    "conc-sock-in-loop", call,
+                    f"blocking call {target}() inside an async handler "
+                    "stalls the shared event loop — every tenant freezes "
+                    "together; use asyncio streams / asyncio.sleep, or "
+                    "push it through run_in_executor",
+                ))
+
+
 @register_family("concurrency")
 def check_concurrency(project: Project) -> List[Finding]:
     findings: List[Finding] = []
@@ -315,6 +376,9 @@ def check_concurrency(project: Project) -> List[Finding]:
 
     for src in project.in_dirs(*JOURNAL_SCOPE):
         _check_journal_writer(src, findings)
+
+    for src in project.in_dirs(*SERVE_ASYNC_SCOPE):
+        _check_sock_in_loop(src, findings)
 
     for src in project.in_dirs(*BLOCK_SCOPE):
         parents = _parents(src.tree)

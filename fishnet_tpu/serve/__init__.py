@@ -1,0 +1,30 @@
+"""fishnet-tpu serve: the multi-tenant analysis-serving subsystem.
+
+The reference is a long-poll *client* of lichess; this package inverts
+it (ROADMAP.md "New directions" #1): `python -m fishnet_tpu serve` runs
+an asyncio HTTP/JSON endpoint that many concurrent callers multiplex
+into. Requests become `PositionRequest`s (engine/session.py) with a
+per-request deadline and priority, pass an admission controller with a
+bounded waiting room (admission.py), and feed the same lane pool the
+lichess client and bench feed — against the TPU engine, every tenant's
+positions land in the LaneScheduler's hardest-deadline-first pending
+queue.
+
+Stdlib only: asyncio.start_server plus a minimal HTTP/1.1 layer
+(server.py); serde in protocol.py. docs/serving.md is the protocol and
+operations reference.
+"""
+from .admission import AdmissionController, Shed
+from .protocol import ProtocolError, ServeRequest, parse_request, request_to_json
+from .server import ServeApp, run_serve
+
+__all__ = [
+    "AdmissionController",
+    "ProtocolError",
+    "ServeApp",
+    "ServeRequest",
+    "Shed",
+    "parse_request",
+    "request_to_json",
+    "run_serve",
+]

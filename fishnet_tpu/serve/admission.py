@@ -1,0 +1,217 @@
+"""Admission control: bounded in-flight depth, backpressure, shedding.
+
+The LaneScheduler absorbs any number of pending positions, but an HTTP
+front-end must not convert overload into an unbounded queue of doomed
+requests — the reference client's own 429 handling (client/api.py)
+assumes servers shed. Policy:
+
+- at most `max_inflight` positions are inside the engine at once (sized
+  to the lane pool: beyond it, extra admissions only deepen the
+  scheduler's pending queue and every deadline slips together);
+- up to `max_queue` further positions may wait in an ordered waiting
+  room. Admission order is (priority tier, deadline): interactive
+  bestmove outranks batch analysis, and within a tier the hardest
+  deadline goes first — the same key the LaneScheduler uses, so the
+  waiting room never inverts the device-side order;
+- past that, requests are shed immediately with `Shed` → HTTP 429 and a
+  Retry-After derived from the measured drain rate: an EWMA of completed
+  positions/second, divided into the current backlog. Saturation sheds
+  in microseconds instead of holding sockets open;
+- a waiter whose own deadline expires before a slot frees is shed too
+  (it could only miss).
+
+Per-tenant counters land in the obs/metrics registry
+(`fishnet_serve_*`): requests/positions/sheds per tenant plus a request
+latency histogram — the occupancy gauges from the scheduler next to the
+shed rate are the autoscaling signal (docs/serving.md).
+
+Event-loop native: admit() is async and the state is only touched from
+the server's loop, so no lock is needed; metrics objects carry their
+own locks.
+"""
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import metrics as obs_metrics
+
+RETRY_AFTER_MIN_S = 1
+RETRY_AFTER_MAX_S = 60
+
+# EWMA horizon for the drain rate: ~30 completed requests of memory.
+_DRAIN_ALPHA = 1.0 / 30.0
+
+
+class Shed(Exception):
+    """Request refused at admission; carries the Retry-After hint."""
+
+    def __init__(self, retry_after: int, reason: str):
+        super().__init__(reason)
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class Ticket:
+    """One admitted request's claim on in-flight capacity."""
+
+    __slots__ = ("tenant", "n_positions", "admitted_at")
+
+    def __init__(self, tenant: str, n_positions: int, admitted_at: float):
+        self.tenant = tenant
+        self.n_positions = n_positions
+        self.admitted_at = admitted_at
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        max_inflight: int,
+        max_queue: int,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
+        assert max_inflight >= 1
+        self.max_inflight = max_inflight
+        self.max_queue = max_queue
+        self.registry = registry if registry is not None else obs_metrics.REGISTRY
+        self._inflight = 0  # positions inside the engine
+        self._queued = 0  # positions waiting for a slot
+        # waiting room: (priority, deadline, seq) → hardest first
+        self._waiters: List[Tuple[int, float, int, dict]] = []
+        self._seq = itertools.count()
+        # measured drain rate (positions/s), EWMA over completions
+        self._drain_rate = 0.0
+        self._last_release = time.monotonic()
+        self._g_inflight = self.registry.gauge(
+            "fishnet_serve_inflight",
+            "positions currently admitted into the engine",
+        )
+        self._g_queued = self.registry.gauge(
+            "fishnet_serve_queued",
+            "positions waiting for an in-flight slot",
+        )
+
+    # ------------------------------------------------------------ metrics
+
+    def _tenant_counter(self, what: str, tenant: str) -> obs_metrics.Counter:
+        return self.registry.counter(
+            f"fishnet_serve_{what}_total_{tenant}",
+            f"served {what} for tenant {tenant}",
+        )
+
+    def _latency_histogram(self, tenant: str) -> obs_metrics.Histogram:
+        return self.registry.histogram(
+            f"fishnet_serve_latency_ms_{tenant}",
+            f"request latency (ms) for tenant {tenant}",
+        )
+
+    # ------------------------------------------------------- admission
+
+    def occupancy(self) -> Tuple[int, int]:
+        return self._inflight, self._queued
+
+    def drain_rate(self) -> float:
+        return self._drain_rate
+
+    def retry_after(self, extra_positions: int = 0) -> int:
+        """Seconds until the current backlog plausibly drains: backlog
+        over the measured drain rate, clamped to [1, 60]. With no drain
+        history yet, the cap — a cold saturated server can only guess
+        pessimistically."""
+        backlog = self._inflight + self._queued + extra_positions
+        if self._drain_rate <= 0.0:
+            return RETRY_AFTER_MAX_S
+        est = backlog / self._drain_rate
+        return max(RETRY_AFTER_MIN_S, min(RETRY_AFTER_MAX_S, int(est) + 1))
+
+    def _shed(self, tenant: str, n: int, reason: str) -> Shed:
+        self._tenant_counter("shed", tenant).inc()
+        return Shed(self.retry_after(extra_positions=n), reason)
+
+    async def admit(
+        self, tenant: str, n_positions: int, deadline: float, priority: int
+    ) -> Ticket:
+        """Claim n_positions of in-flight capacity, waiting in the
+        bounded room if full; raises Shed when the room overflows or the
+        deadline can't be met."""
+        self._tenant_counter("requests", tenant).inc()
+        now = time.monotonic()
+        if deadline <= now:
+            raise self._shed(tenant, n_positions, "deadline already expired")
+        if self._inflight + n_positions <= self.max_inflight and not self._waiters:
+            return self._grant(tenant, n_positions)
+        if self._queued + n_positions > self.max_queue:
+            raise self._shed(tenant, n_positions, "server saturated")
+        slot = {
+            "future": asyncio.get_running_loop().create_future(),
+            "tenant": tenant,
+            "n": n_positions,
+        }
+        heapq.heappush(
+            self._waiters, (priority, deadline, next(self._seq), slot)
+        )
+        self._queued += n_positions
+        self._g_queued.set(self._queued)
+        try:
+            timeout = deadline - time.monotonic()
+            return await asyncio.wait_for(slot["future"], timeout=timeout)
+        except asyncio.TimeoutError:
+            raise self._shed(
+                tenant, 0, "deadline expired waiting for capacity"
+            ) from None
+        finally:
+            if not slot["future"].done():
+                slot["future"].cancel()
+            self._evict(slot)
+
+    def _grant(self, tenant: str, n_positions: int) -> Ticket:
+        self._inflight += n_positions
+        self._g_inflight.set(self._inflight)
+        self._tenant_counter("positions", tenant).inc(n_positions)
+        return Ticket(tenant, n_positions, time.monotonic())
+
+    def _evict(self, slot: dict) -> None:
+        """Drop a cancelled/timed-out waiter from the room accounting (the
+        heap entry is lazily skipped by _pump once its future is done)."""
+        if slot.get("evicted"):
+            return
+        slot["evicted"] = True
+        self._queued -= slot["n"]
+        self._g_queued.set(self._queued)
+
+    def _pump(self) -> None:
+        """Admit waiters while capacity allows — hardest (priority,
+        deadline) first."""
+        while self._waiters:
+            _prio, _dl, _seq, slot = self._waiters[0]
+            fut = slot["future"]
+            if fut.done():  # timed out / cancelled; already evicted
+                heapq.heappop(self._waiters)
+                continue
+            if self._inflight + slot["n"] > self.max_inflight:
+                return
+            heapq.heappop(self._waiters)
+            self._evict(slot)
+            fut.set_result(self._grant(slot["tenant"], slot["n"]))
+
+    def release(self, ticket: Ticket, ok: bool = True) -> None:
+        """Return capacity; feeds the drain-rate EWMA and the per-tenant
+        latency histogram, then admits eligible waiters."""
+        now = time.monotonic()
+        self._inflight -= ticket.n_positions
+        self._g_inflight.set(self._inflight)
+        if ok:
+            dt = max(now - ticket.admitted_at, 1e-6)
+            inst = ticket.n_positions / dt
+            if self._drain_rate <= 0.0:
+                self._drain_rate = inst
+            else:
+                self._drain_rate += _DRAIN_ALPHA * (inst - self._drain_rate)
+            self._latency_histogram(ticket.tenant).observe(dt * 1000.0)
+            self._tenant_counter("completed", ticket.tenant).inc()
+        else:
+            self._tenant_counter("failed", ticket.tenant).inc()
+        self._pump()

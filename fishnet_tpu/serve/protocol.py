@@ -1,0 +1,213 @@
+"""Request/response serde for the serving endpoint.
+
+One JSON object per HTTP request. The two endpoints share a body shape:
+
+    POST /analyse   {"id": "...", "tenant": "team-a",
+                     "variant": "standard",
+                     "positions": [{"fen": "...", "moves": ["e2e4", ...]},
+                                   ...],
+                     "depth": 8, "multipv": 1, "nodes": 400000,
+                     "priority": "batch", "timeout_ms": 6000}
+    POST /bestmove  {"id": "...", "tenant": "bot-x",
+                     "positions": [{"fen": "...", "moves": [...]}],
+                     "level": 6, "priority": "interactive"}
+
+and a response shape mirroring the pipe-wire PositionResponse form
+(client/ipc.py response_to_wire — scores/pvs matrices, best_move, depth,
+nodes, time_s, nps), one result per position in request order:
+
+    {"id": "...", "results": [{...}, ...], "latency_ms": 12.3}
+
+The echoed "id" is the exactly-once handle smoke clients assert on.
+Backpressure replies are JSON too: {"error": "...", "retry_after": N}
+with HTTP 429 and a Retry-After header.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..client.ipc import PositionResponse, response_to_wire
+from ..engine.session import (
+    PRIORITY_BATCH,
+    PRIORITY_INTERACTIVE,
+    PositionRequest,
+)
+
+MAX_POSITIONS_PER_REQUEST = 64
+MAX_MOVES_PER_POSITION = 1024
+
+_PRIORITY_NAMES = {
+    "interactive": PRIORITY_INTERACTIVE,
+    "batch": PRIORITY_BATCH,
+}
+_PRIORITY_VALUES = {v: k for k, v in _PRIORITY_NAMES.items()}
+
+
+class ProtocolError(ValueError):
+    """Malformed request body; the server answers HTTP 400 with this
+    message."""
+
+
+@dataclass(frozen=True)
+class ServeRequest:
+    """One parsed request body (either endpoint)."""
+
+    kind: str  # "analysis" | "bestmove"
+    positions: Tuple[Tuple[str, Tuple[str, ...]], ...]  # (fen, moves)
+    id: str = ""
+    tenant: str = "default"
+    variant: str = "standard"
+    depth: Optional[int] = None
+    multipv: Optional[int] = None
+    nodes: Optional[int] = None
+    level: int = 8
+    priority: int = PRIORITY_BATCH
+    timeout_ms: Optional[int] = None
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ProtocolError(msg)
+
+
+def _parse_positions(obj: dict) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
+    raw = obj.get("positions")
+    _require(isinstance(raw, list) and raw, "positions must be a non-empty list")
+    _require(
+        len(raw) <= MAX_POSITIONS_PER_REQUEST,
+        f"at most {MAX_POSITIONS_PER_REQUEST} positions per request",
+    )
+    out = []
+    for p in raw:
+        _require(isinstance(p, dict), "each position must be an object")
+        fen = p.get("fen")
+        _require(isinstance(fen, str) and bool(fen.strip()), "position.fen required")
+        moves = p.get("moves", [])
+        _require(
+            isinstance(moves, list) and all(isinstance(m, str) for m in moves),
+            "position.moves must be a list of UCI strings",
+        )
+        _require(
+            len(moves) <= MAX_MOVES_PER_POSITION,
+            f"at most {MAX_MOVES_PER_POSITION} moves per position",
+        )
+        out.append((fen, tuple(moves)))
+    return tuple(out)
+
+
+def _opt_int(obj: dict, key: str, lo: int, hi: int) -> Optional[int]:
+    v = obj.get(key)
+    if v is None:
+        return None
+    _require(isinstance(v, int) and not isinstance(v, bool), f"{key} must be an integer")
+    _require(lo <= v <= hi, f"{key} out of range [{lo}, {hi}]")
+    return v
+
+
+def parse_request(kind: str, obj: object) -> ServeRequest:
+    """Validate one JSON body for /analyse or /bestmove."""
+    _require(kind in ("analysis", "bestmove"), f"unknown request kind {kind!r}")
+    _require(isinstance(obj, dict), "request body must be a JSON object")
+    assert isinstance(obj, dict)
+    rid = obj.get("id", "")
+    _require(isinstance(rid, str) and len(rid) <= 64, "id must be a string <= 64 chars")
+    tenant = obj.get("tenant", "default")
+    _require(
+        isinstance(tenant, str) and 0 < len(tenant) <= 32,
+        "tenant must be a non-empty string <= 32 chars",
+    )
+    variant = obj.get("variant", "standard")
+    _require(isinstance(variant, str) and bool(variant), "variant must be a string")
+    priority_name = obj.get(
+        "priority", "interactive" if kind == "bestmove" else "batch"
+    )
+    _require(
+        priority_name in _PRIORITY_NAMES,
+        f"priority must be one of {sorted(_PRIORITY_NAMES)}",
+    )
+    level = obj.get("level", 8)
+    _require(
+        isinstance(level, int) and not isinstance(level, bool) and 1 <= level <= 8,
+        "level must be an integer in 1..8",
+    )
+    return ServeRequest(
+        kind=kind,
+        positions=_parse_positions(obj),
+        id=rid,
+        tenant=tenant,
+        variant=variant,
+        depth=_opt_int(obj, "depth", 1, 64),
+        multipv=_opt_int(obj, "multipv", 1, 5),
+        nodes=_opt_int(obj, "nodes", 1, 1_000_000_000),
+        level=level,
+        priority=_PRIORITY_NAMES[priority_name],
+        timeout_ms=_opt_int(obj, "timeout_ms", 1, 600_000),
+    )
+
+
+def request_to_json(req: ServeRequest) -> dict:
+    """Inverse of parse_request (round-trip tested; the smoke client and
+    bench build bodies through this so the two sides can't drift)."""
+    out: dict = {
+        "positions": [
+            {"fen": fen, "moves": list(moves)} for fen, moves in req.positions
+        ],
+        "priority": _PRIORITY_VALUES[req.priority],
+    }
+    if req.id:
+        out["id"] = req.id
+    if req.tenant != "default":
+        out["tenant"] = req.tenant
+    if req.variant != "standard":
+        out["variant"] = req.variant
+    if req.kind == "bestmove":
+        out["level"] = req.level
+    for key in ("depth", "multipv", "nodes", "timeout_ms"):
+        v = getattr(req, key)
+        if v is not None:
+            out[key] = v
+    return out
+
+
+def to_position_requests(
+    req: ServeRequest, deadline: float
+) -> List[PositionRequest]:
+    """Expand one admitted request into PositionRequests sharing the
+    deadline the admission controller stamped on it."""
+    return [
+        PositionRequest(
+            fen=fen,
+            moves=moves,
+            variant=req.variant,
+            kind=req.kind,
+            depth=req.depth,
+            multipv=req.multipv,
+            nodes=req.nodes,
+            level=req.level,
+            deadline=deadline,
+            priority=req.priority,
+        )
+        for fen, moves in req.positions
+    ]
+
+
+def results_to_json(
+    req: ServeRequest, responses: List[PositionResponse], latency_s: float
+) -> dict:
+    results = []
+    for res in responses:
+        wire = response_to_wire(res)
+        # position_index/url are chunk-protocol bookkeeping; the HTTP
+        # answer is ordered by the request's own positions list
+        wire.pop("position_index", None)
+        wire.pop("url", None)
+        results.append(wire)
+    out = {"results": results, "latency_ms": round(latency_s * 1000.0, 3)}
+    if req.id:
+        out["id"] = req.id
+    return out
+
+
+def shed_to_json(retry_after: int, reason: str) -> dict:
+    return {"error": reason, "retry_after": int(retry_after)}

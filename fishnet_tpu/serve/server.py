@@ -1,0 +1,367 @@
+"""The asyncio HTTP/1.1 front-end: many tenants, one lane pool.
+
+Stdlib only — `asyncio.start_server` plus a hand-rolled HTTP/1.1 layer
+(request line, headers, Content-Length bodies, keep-alive). Endpoints:
+
+    POST /analyse    batch analysis  (protocol.py body shape)
+    POST /bestmove   play-speed move requests
+    GET  /healthz    JSON liveness/occupancy summary
+
+Every accepted request is stamped with a deadline (its own timeout_ms
+clamped by FISHNET_TPU_SERVE_TIMEOUT_MS), passes the admission
+controller (429 + Retry-After on saturation, admission.py), and is
+expanded into `PositionRequest`s submitted through one shared
+`EngineSession` — against the TPU engine all tenants' positions merge
+into the LaneScheduler's hardest-deadline-first pending queue.
+
+Graceful drain: SIGTERM/SIGINT closes the listener, in-flight requests
+finish (bounded by FISHNET_TPU_SERVE_DRAIN_S), per-tenant totals are
+flushed to the log and the metrics registry snapshot, then the process
+exits. New requests during the drain get 503 + Connection: close.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import time
+from typing import Dict, Optional, Tuple
+
+from ..client.logger import Logger
+from ..engine.base import EngineError
+from ..engine.session import EngineSession
+from ..obs import metrics as obs_metrics
+from ..utils import settings
+from .admission import AdmissionController, Shed
+from .protocol import (
+    ProtocolError,
+    parse_request,
+    results_to_json,
+    shed_to_json,
+    to_position_requests,
+)
+
+MAX_HEADER_BYTES = 32768
+MAX_BODY_BYTES = 4 * 1024 * 1024
+# keep-alive idle cutoff: a silent client must not pin a connection
+# handler forever
+IDLE_TIMEOUT_S = 75.0
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+_ENDPOINTS = {"/analyse": "analysis", "/bestmove": "bestmove"}
+
+
+class _BadRequest(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class ServeApp:
+    """One server instance: listener + admission + shared session."""
+
+    def __init__(
+        self,
+        session: EngineSession,
+        max_inflight: Optional[int] = None,
+        max_queue: Optional[int] = None,
+        default_timeout_ms: Optional[int] = None,
+        drain_s: Optional[float] = None,
+        logger: Optional[Logger] = None,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+    ):
+        self.session = session
+        self.logger = logger or Logger()
+        if max_inflight is None:
+            max_inflight = settings.get_int("FISHNET_TPU_SERVE_MAX_INFLIGHT")
+        if max_queue is None:
+            max_queue = settings.get_int("FISHNET_TPU_SERVE_MAX_QUEUE")
+        if default_timeout_ms is None:
+            default_timeout_ms = settings.get_int("FISHNET_TPU_SERVE_TIMEOUT_MS")
+        if drain_s is None:
+            drain_s = float(settings.get_int("FISHNET_TPU_SERVE_DRAIN_S"))
+        self.default_timeout_ms = default_timeout_ms
+        self.drain_s = drain_s
+        self.registry = registry if registry is not None else obs_metrics.REGISTRY
+        self.admission = AdmissionController(
+            max_inflight, max_queue, registry=self.registry
+        )
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._draining = False
+        self._open_requests = 0
+        self._drained = asyncio.Event()
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str, port: int) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, host=host, port=port
+        )
+        bound = self._server.sockets[0].getsockname()
+        return bound[0], bound[1]
+
+    def begin_drain(self) -> None:
+        """Stop accepting; in-flight requests run to completion."""
+        if self._draining:
+            return
+        self._draining = True
+        self.logger.headline("serve: draining (no new requests)")
+        if self._server is not None:
+            self._server.close()
+        if self._open_requests == 0:
+            self._drained.set()
+
+    async def drain_and_stop(self) -> None:
+        """Wait for in-flight work (bounded by drain_s), then stop."""
+        self.begin_drain()
+        try:
+            await asyncio.wait_for(self._drained.wait(), timeout=self.drain_s)
+        except asyncio.TimeoutError:
+            self.logger.warn(
+                f"serve: drain grace period ({self.drain_s:.0f}s) expired "
+                f"with {self._open_requests} request(s) still open"
+            )
+        if self._server is not None:
+            await self._server.wait_closed()
+        self._flush_stats()
+
+    def _flush_stats(self) -> None:
+        snap = self.registry.snapshot()
+        served = {
+            k: v for k, v in sorted(snap.items())
+            if k.startswith("fishnet_serve_") and not k.endswith("_sum")
+        }
+        parts = ", ".join(f"{k.removeprefix('fishnet_serve_')}={int(v)}"
+                          for k, v in served.items())
+        self.logger.headline(f"serve: final stats: {parts or 'no requests'}")
+
+    # ------------------------------------------------------------ transport
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                try:
+                    request = await asyncio.wait_for(
+                        self._read_request(reader), timeout=IDLE_TIMEOUT_S
+                    )
+                except asyncio.TimeoutError:
+                    break
+                if request is None:  # clean EOF between requests
+                    break
+                method, path, headers, body = request
+                want_close = (
+                    headers.get("connection", "").lower() == "close"
+                    or self._draining
+                )
+                status, payload, extra = await self._dispatch(
+                    method, path, headers, body
+                )
+                await self._write_response(
+                    writer, status, payload, extra, close=want_close
+                )
+                if want_close:
+                    break
+        except _BadRequest as e:
+            # malformed transport framing: answer once and hang up
+            try:
+                await self._write_response(
+                    writer, e.status, {"error": e.message}, {}, close=True
+                )
+            except (ConnectionError, OSError):
+                pass  # peer already gone; nothing to answer
+        except (ConnectionError, asyncio.IncompleteReadError, OSError) as e:
+            self.logger.debug(f"serve: connection dropped: {e}")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass  # close raced the peer's reset; already closed
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            parts = line.decode("latin-1").split()
+            method, target, _version = parts[0], parts[1], parts[2]
+        except (IndexError, UnicodeDecodeError):
+            raise _BadRequest(400, "malformed request line") from None
+        headers: Dict[str, str] = {}
+        total = len(line)
+        while True:
+            h = await reader.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            total += len(h)
+            if total > MAX_HEADER_BYTES:
+                raise _BadRequest(400, "headers too large")
+            name, sep, value = h.decode("latin-1").partition(":")
+            if not sep:
+                raise _BadRequest(400, "malformed header")
+            headers[name.strip().lower()] = value.strip()
+        try:
+            length = int(headers.get("content-length", "0"))
+        except ValueError:
+            raise _BadRequest(400, "bad Content-Length") from None
+        if length > MAX_BODY_BYTES:
+            raise _BadRequest(413, "body too large")
+        body = await reader.readexactly(length) if length > 0 else b""
+        return method, target.split("?", 1)[0], headers, body
+
+    async def _write_response(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        payload: dict,
+        extra_headers: Dict[str, str],
+        close: bool,
+    ) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        lines = [
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+            "Content-Type: application/json",
+            f"Content-Length: {len(body)}",
+            f"Connection: {'close' if close else 'keep-alive'}",
+        ]
+        lines.extend(f"{k}: {v}" for k, v in extra_headers.items())
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode("latin-1") + body)
+        await writer.drain()
+
+    # ------------------------------------------------------------ handlers
+
+    async def _dispatch(
+        self, method: str, path: str, headers: Dict[str, str], body: bytes
+    ) -> Tuple[int, dict, Dict[str, str]]:
+        if path == "/healthz":
+            if method != "GET":
+                return 405, {"error": "use GET"}, {}
+            inflight, queued = self.admission.occupancy()
+            return 200, {
+                "status": "draining" if self._draining else "ok",
+                "inflight": inflight,
+                "queued": queued,
+                "drain_rate_pos_per_s": round(self.admission.drain_rate(), 3),
+            }, {}
+        kind = _ENDPOINTS.get(path)
+        if kind is None:
+            return 404, {"error": f"no such endpoint {path}"}, {}
+        if method != "POST":
+            return 405, {"error": "use POST"}, {}
+        if self._draining:
+            return 503, {"error": "draining"}, {"Retry-After": "5"}
+        try:
+            obj = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return 400, {"error": "body is not valid JSON"}, {}
+        try:
+            sreq = parse_request(kind, obj)
+        except ProtocolError as e:
+            return 400, {"error": str(e)}, {}
+        return await self._serve_request(sreq)
+
+    async def _serve_request(self, sreq) -> Tuple[int, dict, Dict[str, str]]:
+        timeout_ms = min(
+            sreq.timeout_ms or self.default_timeout_ms, self.default_timeout_ms
+        )
+        t0 = time.monotonic()
+        deadline = t0 + timeout_ms / 1000.0
+        self._open_requests += 1
+        try:
+            try:
+                ticket = await self.admission.admit(
+                    sreq.tenant, len(sreq.positions), deadline, sreq.priority
+                )
+            except Shed as e:
+                return 429, shed_to_json(e.retry_after, e.reason), {
+                    "Retry-After": str(e.retry_after)
+                }
+            ok = False
+            try:
+                responses = await self.session.submit_many(
+                    to_position_requests(sreq, deadline)
+                )
+                ok = True
+            except EngineError as e:
+                self.logger.error(f"serve: engine error: {e}")
+                return 500, {"error": f"engine error: {e}"}, {}
+            finally:
+                self.admission.release(ticket, ok=ok)
+            return 200, results_to_json(sreq, responses, time.monotonic() - t0), {}
+        finally:
+            self._open_requests -= 1
+            if self._draining and self._open_requests == 0:
+                self._drained.set()
+
+
+async def run_serve(cfg) -> int:
+    """`python -m fishnet_tpu serve` entry: build the engine for the
+    configured backend, share it through one EngineSession, serve until
+    SIGTERM/SIGINT, drain, exit."""
+    from ..client.app import make_engine_factory
+    from ..client.wire import EngineFlavor
+
+    logger = Logger(verbose=cfg.verbose)
+    host = cfg.serve_host or settings.get_str("FISHNET_TPU_SERVE_HOST")
+    port = (
+        cfg.serve_port
+        if cfg.serve_port is not None
+        else settings.get_int("FISHNET_TPU_SERVE_PORT")
+    )
+
+    factory = make_engine_factory(cfg, logger)
+    flavor = (
+        EngineFlavor.TPU if cfg.backend == "tpu" else EngineFlavor.OFFICIAL
+    )
+    engine = factory(flavor)
+    if cfg.backend == "tpu":
+        logger.info("serve: warming up TPU engine (compiling search program) ...")
+        if cfg.supervisor:
+            await engine.start()
+            logger.info("serve: supervised TPU engine host ready.")
+        else:
+            await asyncio.to_thread(engine.warmup, None, logger.info)
+            logger.info("serve: TPU engine ready.")
+
+    session = EngineSession(engine, flavor=flavor)
+    app = ServeApp(session, logger=logger)
+    bound_host, bound_port = await app.start(host, port)
+    # the smoke client and bench parse this exact line to find an
+    # ephemeral port (FISHNET_TPU_SERVE_PORT=0)
+    logger.headline(f"serve: listening on {bound_host}:{bound_port}")
+
+    metrics_server = obs_metrics.serve_from_settings()
+    if metrics_server is not None:
+        logger.info(
+            "serve: metrics at "
+            f"http://127.0.0.1:{metrics_server.server_address[1]}/metrics"
+        )
+
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, stop.set)
+        loop.add_signal_handler(signal.SIGINT, stop.set)
+    except NotImplementedError:
+        pass  # non-unix
+    await stop.wait()  # fishnet-lint: disable=conc-no-timeout
+    await app.drain_and_stop()
+    await session.close()
+    await engine.close()
+    logger.headline("serve: bye.")
+    return 0

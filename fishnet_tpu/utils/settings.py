@@ -272,6 +272,55 @@ SETTINGS: Tuple[Setting, ...] = (
             "endpoint.",
     ),
     Setting(
+        name="FISHNET_TPU_SERVE_HOST",
+        kind="str",
+        default="127.0.0.1",
+        doc="Bind address for the analysis-serving endpoint "
+            "(`fishnet-tpu serve`, fishnet_tpu/serve/). The default is "
+            "loopback; bind a routable address only behind your own "
+            "auth/TLS front proxy.",
+    ),
+    Setting(
+        name="FISHNET_TPU_SERVE_PORT",
+        kind="int",
+        default="9670",
+        doc="TCP port for the analysis-serving endpoint; 0 binds an "
+            "OS-assigned ephemeral port (smoke tests parse the "
+            "\"listening on\" line).",
+    ),
+    Setting(
+        name="FISHNET_TPU_SERVE_MAX_INFLIGHT",
+        kind="int",
+        default="768",
+        doc="Admission controller: maximum positions admitted into the "
+            "engine concurrently across all tenants (fishnet_tpu/serve/"
+            "admission.py); sized to the lane pool.",
+    ),
+    Setting(
+        name="FISHNET_TPU_SERVE_MAX_QUEUE",
+        kind="int",
+        default="256",
+        doc="Admission controller: positions allowed to wait for a free "
+            "in-flight slot before new requests are shed with HTTP 429 "
+            "(bounded waiting room, hardest-deadline-first admission).",
+    ),
+    Setting(
+        name="FISHNET_TPU_SERVE_TIMEOUT_MS",
+        kind="int",
+        default="8000",
+        doc="Default and maximum per-request deadline for served "
+            "analysis/bestmove requests; a request's own timeout_ms is "
+            "clamped to this.",
+    ),
+    Setting(
+        name="FISHNET_TPU_SERVE_DRAIN_S",
+        kind="int",
+        default="20",
+        doc="Graceful-drain grace period on SIGTERM/SIGINT: the server "
+            "stops accepting, finishes in-flight requests for up to this "
+            "many seconds, flushes stats, then exits.",
+    ),
+    Setting(
         name="FISHNET_TPU_COMPILE_CACHE",
         kind="str",
         default="",

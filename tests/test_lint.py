@@ -379,6 +379,54 @@ def step(lock, q, out):
     assert len(by_rule(result.findings, "conc-block-in-lock")) == 1
 
 
+def test_sock_in_loop_rule(tmp_path):
+    src = '''
+import asyncio
+import socket
+import time
+
+
+async def handler(sock, reader, writer):
+    time.sleep(0.5)                     # flagged
+    data = sock.recv(4096)              # flagged
+    await asyncio.sleep(0.5)            # asyncio.sleep: fine
+    line = await reader.readline()      # asyncio streams: fine
+    writer.write(line)
+    await writer.drain()
+
+    def blocking_helper():              # sync helper -> to_thread: fine
+        return sock.recv(1)
+
+    return data, await asyncio.to_thread(blocking_helper)
+
+
+def sync_path(sock):
+    return sock.recv(1)                 # not in an async def: fine
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/serve/server.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    flagged = by_rule(result.findings, "conc-sock-in-loop")
+    assert sorted(f.line for f in flagged) == [8, 9]
+
+
+def test_sock_in_loop_out_of_scope(tmp_path):
+    # the same code outside fishnet_tpu/serve/ must not fire
+    src = '''
+import time
+
+
+async def handler():
+    time.sleep(0.5)
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/obs/push.py": src}
+    )
+    result = run_lint(project, only_families={"concurrency"})
+    assert by_rule(result.findings, "conc-sock-in-loop") == []
+
+
 def test_except_rules(tmp_path):
     src = '''
 def f(log):

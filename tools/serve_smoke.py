@@ -1,0 +1,274 @@
+"""Acceptance smoke for the serving front-end (fishnet_tpu/serve/).
+
+Boots `python -m fishnet_tpu serve --backend python --serve-port 0`
+as a real subprocess, discovers the ephemeral port from the
+`serve: listening on host:port` headline, then:
+
+1. exactly-once under concurrency — N client threads (default 16, one
+   HTTP connection each) fire mixed /analyse + /bestmove requests with
+   unique ids; every id must come back exactly once, HTTP 200, with one
+   result per submitted position and a best_move on each;
+2. graceful drain — a second wave is launched and SIGTERM lands while
+   it is in flight; every already-accepted request must still answer
+   200 (the drain finishes in-flight work) and the server must exit 0
+   after printing its final stats line.
+
+Pure stdlib (threads + http.client, deliberately *not* asyncio: the
+point is independent real connections), CI-friendly:
+
+    python tools/serve_smoke.py
+    python tools/serve_smoke.py --clients 16 --format=github
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+LISTEN_PREFIX = "serve: listening on "
+BOOT_TIMEOUT_S = 60.0
+EXIT_TIMEOUT_S = 30.0
+
+
+class SmokeFailure(Exception):
+    pass
+
+
+def _start_server():
+    """Spawn the serve subprocess; returns (proc, line_queue, host, port)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fishnet_tpu", "serve",
+         "--backend", "python", "--serve-port", "0", "--no-conf"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=str(Path(__file__).resolve().parents[1]),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def pump():
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            sys.stdout.write(f"  [serve] {line}")
+            lines.put(line.rstrip("\n"))
+        lines.put("")  # EOF marker
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    deadline = time.monotonic() + BOOT_TIMEOUT_S
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise SmokeFailure("server never printed its listening line")
+        try:
+            line = lines.get(timeout=remaining)
+        except queue.Empty:
+            raise SmokeFailure(
+                "server never printed its listening line"
+            ) from None
+        if not line and proc.poll() is not None:
+            raise SmokeFailure(
+                f"server exited early with code {proc.returncode}"
+            )
+        if LISTEN_PREFIX in line:
+            hostport = line.split(LISTEN_PREFIX, 1)[1].strip()
+            host, _, port = hostport.rpartition(":")
+            return proc, lines, host, int(port)
+
+
+def _body_for(client_id: int, req_id: str, i: int) -> tuple:
+    """Alternate analysis and bestmove shapes, varying position count."""
+    if (client_id + i) % 2 == 0:
+        n_pos = 1 + (i % 3)
+        return "/analyse", {
+            "id": req_id,
+            "tenant": f"smoke-{client_id % 4}",
+            "positions": [
+                {"fen": START, "moves": ["e2e4", "e7e5"][: (i + k) % 3]}
+                for k in range(n_pos)
+            ],
+            "depth": 2,
+            "timeout_ms": 30_000,
+        }
+    return "/bestmove", {
+        "id": req_id,
+        "tenant": f"smoke-{client_id % 4}",
+        "positions": [{"fen": START, "moves": ["e2e4"][: i % 2]}],
+        "level": 1 + (i % 8),
+        "timeout_ms": 30_000,
+    }
+
+
+def _post(host: str, port: int, path: str, body: dict) -> tuple:
+    conn = http.client.HTTPConnection(host, port, timeout=60.0)
+    try:
+        conn.request(
+            "POST", path, body=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        payload = json.loads(resp.read().decode("utf-8"))
+        return resp.status, payload
+    finally:
+        conn.close()
+
+
+def _client_wave(host, port, clients, per_client, results, errors):
+    """Run `clients` threads, each sending `per_client` requests over
+    its own connections; record (id -> [payloads]) and errors."""
+    lock = threading.Lock()
+
+    def one_client(cid: int):
+        for i in range(per_client):
+            req_id = f"c{cid:02d}-r{i}"
+            path, body = _body_for(cid, req_id, i)
+            try:
+                status, payload = _post(host, port, path, body)
+            except (OSError, ValueError) as e:
+                with lock:
+                    errors.append(f"{req_id}: transport error: {e}")
+                continue
+            with lock:
+                results.setdefault(req_id, []).append(
+                    (status, path, len(body["positions"]), payload)
+                )
+
+    threads = [
+        threading.Thread(target=one_client, args=(cid,))
+        for cid in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+        if t.is_alive():
+            errors.append("client thread hung")
+
+
+def _check_exactly_once(results, errors, expected_ids):
+    for req_id in expected_ids:
+        got = results.get(req_id, [])
+        if len(got) != 1:
+            errors.append(
+                f"{req_id}: expected exactly one response, got {len(got)}"
+            )
+            continue
+        status, path, n_pos, payload = got[0]
+        if status != 200:
+            errors.append(f"{req_id}: HTTP {status}: {payload}")
+            continue
+        if payload.get("id") != req_id:
+            errors.append(f"{req_id}: echoed id {payload.get('id')!r}")
+            continue
+        res = payload.get("results", [])
+        if len(res) != n_pos:
+            errors.append(
+                f"{req_id}: {len(res)} results for {n_pos} positions"
+            )
+            continue
+        if any(not r.get("best_move") for r in res):
+            errors.append(f"{req_id}: missing best_move in {path} result")
+
+
+def run_smoke(clients: int, per_client: int) -> None:
+    proc, lines, host, port = _start_server()
+    try:
+        # ---- wave 1: exactly-once under concurrency ------------------
+        print(f"serve-smoke: wave 1 — {clients} clients x {per_client} "
+              f"requests against {host}:{port}")
+        results: dict = {}
+        errors: list = []
+        _client_wave(host, port, clients, per_client, results, errors)
+        expected = [
+            f"c{cid:02d}-r{i}"
+            for cid in range(clients) for i in range(per_client)
+        ]
+        _check_exactly_once(results, errors, expected)
+        if errors:
+            raise SmokeFailure(
+                f"wave 1: {len(errors)} failure(s): " + "; ".join(errors[:5])
+            )
+        print(f"serve-smoke: wave 1 ok — {len(expected)} requests, "
+              "exactly-once, all 200")
+
+        # ---- wave 2: SIGTERM mid-flight must drain -------------------
+        print("serve-smoke: wave 2 — SIGTERM mid-flight")
+        results2: dict = {}
+        errors2: list = []
+        wave = threading.Thread(
+            target=_client_wave,
+            args=(host, port, clients, 2, results2, errors2),
+        )
+        wave.start()
+        time.sleep(0.15)  # let requests get in flight
+        proc.send_signal(signal.SIGTERM)
+        wave.join(timeout=120.0)
+        if wave.is_alive():
+            raise SmokeFailure("wave 2: client wave hung after SIGTERM")
+
+        # after SIGTERM, accepted requests must have completed (200) and
+        # late ones may be refused (connection error / 503) — but no
+        # request may vanish or double-answer
+        accepted = {rid: g for rid, g in results2.items() if g}
+        for rid, got in accepted.items():
+            if len(got) != 1:
+                raise SmokeFailure(
+                    f"wave 2: {rid} answered {len(got)} times"
+                )
+            status = got[0][0]
+            if status not in (200, 503):
+                raise SmokeFailure(f"wave 2: {rid} got HTTP {status}")
+        n_ok = sum(1 for g in accepted.values() if g[0][0] == 200)
+        if n_ok == 0:
+            raise SmokeFailure("wave 2: no request completed through drain")
+
+        try:
+            code = proc.wait(timeout=EXIT_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            raise SmokeFailure(
+                "server did not exit within the drain window"
+            ) from None
+        if code != 0:
+            raise SmokeFailure(f"server exited {code} after SIGTERM")
+        print(f"serve-smoke: wave 2 ok — {n_ok} in-flight request(s) "
+              "drained, server exited 0")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--clients", type=int, default=16,
+                        help="concurrent client threads (default 16)")
+    parser.add_argument("--requests-per-client", type=int, default=3)
+    parser.add_argument("--format", choices=["text", "github"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    try:
+        run_smoke(args.clients, args.requests_per_client)
+    except SmokeFailure as e:
+        if args.format == "github":
+            print(f"::error title=serve smoke::{e}")
+        print(f"serve-smoke: FAIL: {e}")
+        return 1
+    print("serve-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
