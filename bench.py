@@ -730,6 +730,112 @@ def run_serve_stage(timeout: float) -> dict | None:
             proc.wait(timeout=10.0)
 
 
+def run_fleet_stage(timeout: float) -> dict | None:
+    """Fleet scaling row (ISSUE 12): the same position workload pushed
+    through the fleet coordinator (fishnet_tpu/fleet/) over 1/2/4
+    fakehost-backed members with a fixed per-chunk service latency.
+    Each member serializes its chunks (one in-flight dispatch, like the
+    real supervised engine), so ideal scaling is linear in members;
+    the row reports positions/s per member count, scaling efficiency
+    vs the single-member run, and the redispatch count (0 — nothing
+    dies here; the chaos gate owns the loss path). CPU-only, no JAX.
+
+    Knobs: BENCH_FLEET=0 skips; BENCH_FLEET_MEMBERS="1,2,4" member
+    counts; BENCH_FLEET_POSITIONS per-count workload (default 48);
+    BENCH_FLEET_LATENCY_MS per-chunk member latency (default 30)."""
+    import asyncio
+
+    from fishnet_tpu.client.backoff import RandomizedBackoff
+    from fishnet_tpu.client.ipc import Chunk, WorkPosition
+    from fishnet_tpu.client.logger import Logger
+    from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+
+    counts = [int(c) for c in
+              os.environ.get("BENCH_FLEET_MEMBERS", "1,2,4").split(",")]
+    positions = int(os.environ.get("BENCH_FLEET_POSITIONS", "48"))
+    latency_ms = float(os.environ.get("BENCH_FLEET_LATENCY_MS", "30"))
+    start_fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    deadline_budget = min(timeout, 120.0)
+
+    def one_chunk(i: int) -> Chunk:
+        work = AnalysisWork(
+            id=f"fleetbench{i:04d}",
+            nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+            timeout_s=deadline_budget, depth=1, multipv=None,
+        )
+        return Chunk(
+            work=work, deadline=time.monotonic() + deadline_budget,
+            variant="standard", flavor=EngineFlavor.TPU,
+            positions=[WorkPosition(
+                work=work, position_index=0, url=None, skip=False,
+                root_fen=start_fen, moves=[])],
+        )
+
+    async def measure(n_members: int) -> dict:
+        members = [
+            make_local_member(
+                f"bench{i}",
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", '{"chunks": ["ok"]}',
+                    "--hb-interval", "0.05",
+                    "--latency-ms", str(latency_ms),
+                ],
+                logger=Logger(verbose=0),
+                hb_interval=0.05, hb_timeout=2.0,
+                backoff=RandomizedBackoff(max_s=0.1),
+            )
+            for i in range(n_members)
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(), loss_window=1.0,
+        )
+        try:
+            await coord.start()  # spawn cost stays out of the window
+            # one warm round so every member has served a chunk
+            await asyncio.gather(
+                *(coord.go_multiple(one_chunk(10_000 + i))
+                  for i in range(n_members)))
+            t0 = time.monotonic()
+            await asyncio.gather(
+                *(coord.go_multiple(one_chunk(i))
+                  for i in range(positions)))
+            wall_s = max(time.monotonic() - t0, 1e-6)
+        finally:
+            await coord.close()
+        return {
+            "positions_per_s": round(positions / wall_s, 1),
+            "redispatches": coord.stats.redispatches,
+            "losses": coord.stats.losses,
+        }
+
+    rows = {}
+    base_pps = None
+    for n in counts:
+        try:
+            row = asyncio.run(
+                asyncio.wait_for(measure(n), timeout=deadline_budget))
+        except (Exception, asyncio.TimeoutError) as e:
+            print(f"bench fleet_scaling: {n}-member run failed: {e}",
+                  file=sys.stderr, flush=True)
+            return None
+        if base_pps is None:
+            base_pps = row["positions_per_s"]
+        row["scaling_x"] = round(row["positions_per_s"] / base_pps, 2)
+        row["efficiency"] = round(row["scaling_x"] / max(n / counts[0], 1),
+                                  3)
+        rows[str(n)] = row
+    return {
+        "latency_ms": latency_ms,
+        "positions": positions,
+        "members": rows,
+    }
+
+
 def device_preflight(timeout: float = 120.0) -> bool:
     """Can a fresh process see the TPU at all? A wedged/down tunnel makes
     jax init hang, which would otherwise burn one full stage timeout per
@@ -921,6 +1027,23 @@ def main() -> None:
             res = run_serve_stage(min(stage_timeout, remaining))
             matrix["serve_latency"] = res
             print("bench config serve_latency: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # fleet scaling row (round 12): 1/2/4 fakehost members behind the
+    # coordinator; ideal scaling is linear (each member serializes its
+    # chunks at a fixed service latency), so positions/s and efficiency
+    # here measure the coordinator's admission + ledger overhead
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120.0:
+            print("bench: skipping fleet_scaling (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["fleet_scaling"] = None
+        else:
+            res = run_fleet_stage(min(stage_timeout, remaining))
+            matrix["fleet_scaling"] = res
+            print("bench config fleet_scaling: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
     if matrix:

@@ -57,6 +57,46 @@ def make_engine_factory(cfg: Config, logger: Logger, stats=None):
 
     def factory(flavor: EngineFlavor):
         nonlocal tpu_engine
+        if cfg.fleet:
+            # fleet mode: every flavor feeds the one coordinator
+            # (fishnet_tpu/fleet/) — it spreads position work over N
+            # members (supervised host children here, or remote serve
+            # endpoints) behind the same Engine protocol, so workers,
+            # serve and bench need no other change
+            if tpu_engine is None:
+                from ..fleet import FleetCoordinator
+                from ..fleet.member import (
+                    make_local_member,
+                    members_from_specs,
+                )
+
+                backend = (
+                    cfg.backend if cfg.backend in ("tpu", "python")
+                    else "tpu"
+                )
+
+                def local_factory(name: str):
+                    return make_local_member(
+                        name,
+                        backend=backend,
+                        weights_path=cfg.tpu_weights,
+                        max_depth=cfg.tpu_depth,
+                        helper_lanes=cfg.tpu_helpers,
+                        refill=cfg.tpu_refill,
+                        mesh_refill=cfg.tpu_mesh_refill,
+                        logger=logger,
+                        stats_recorder=stats,
+                    )
+
+                tpu_engine = FleetCoordinator(
+                    members_from_specs(
+                        cfg.fleet_members,
+                        local_factory=local_factory,
+                        logger=logger,
+                    ),
+                    logger=logger,
+                )
+            return tpu_engine
         if flavor is EngineFlavor.TPU:
             if tpu_engine is None:
                 if cfg.supervisor:
@@ -205,7 +245,12 @@ async def run(cfg: Config) -> int:
         for attempt in range(3):
             try:
                 engine = factory(EngineFlavor.TPU)
-                if cfg.supervisor:
+                if cfg.fleet:
+                    # members spawn concurrently; one that fails to come
+                    # up cools down instead of failing the fleet
+                    await engine.start()
+                    logger.info("Fleet coordinator ready.")
+                elif cfg.supervisor:
                     # the child owns the device: its warmup (and the
                     # background variant compiles, engine/host.py) runs
                     # under heartbeat watch rather than a fixed timeout
@@ -337,10 +382,12 @@ def main(argv=None) -> int:
             run_name="__main__",
         )
         return 0
-    if cfg.command == "serve":
+    if cfg.command in ("serve", "fleet"):
         # the analysis-serving front-end (fishnet_tpu/serve/): many
         # concurrent HTTP tenants multiplex into the same lane pool the
-        # lichess client feeds
+        # lichess client feeds. `fleet` is serve with the coordinator
+        # forced on (cfg.fleet, set by parse): one HTTP front door over
+        # N engine hosts
         from ..serve.server import run_serve
 
         return asyncio.run(run_serve(cfg))

@@ -127,6 +127,12 @@ class Config:
     # defers to the FISHNET_TPU_SERVE_HOST/_PORT registry settings
     serve_host: Optional[str] = None
     serve_port: Optional[int] = None
+    # fleet coordinator (fishnet_tpu/fleet/): --fleet swaps the engine
+    # factory's TPU path for a FleetCoordinator over --fleet-members
+    # (None defers to FISHNET_TPU_FLEET_MEMBERS); the `fleet` command
+    # is `serve` with the coordinator forced on
+    fleet: bool = False
+    fleet_members: Optional[str] = None
     conf: Optional[str] = None
     no_conf: bool = False
     verbose: int = 0
@@ -147,7 +153,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("command", nargs="?", default="run",
                    choices=["run", "configure", "systemd", "systemd-user",
-                            "license", "bench", "serve"])
+                            "license", "bench", "serve", "fleet"])
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--auto-update", action="store_true")
     p.add_argument("--conf", help="path to fishnet.ini")
@@ -190,6 +196,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-port", type=int,
                    help="serve subcommand: TCP port; 0 binds an ephemeral "
                         "port (default FISHNET_TPU_SERVE_PORT)")
+    p.add_argument("--fleet", action="store_true",
+                   help="dispatch work through the fleet coordinator "
+                        "(fishnet_tpu/fleet/) instead of one engine; "
+                        "implied by the `fleet` command")
+    p.add_argument("--fleet-members",
+                   help="comma-separated member specs: 'local', 'local*N' "
+                        "(supervised host children here) or "
+                        "'http://HOST:PORT' (remote serve endpoints); "
+                        "default FISHNET_TPU_FLEET_MEMBERS")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")
     p.add_argument("--max-backoff", help="maximum backoff duration")
@@ -283,6 +298,9 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.serve_host = pick(args.serve_host, "serve_host")
     serve_port = pick(args.serve_port, "serve_port")
     cfg.serve_port = int(serve_port) if serve_port is not None else None
+    cfg.fleet = bool(args.fleet) or args.command == "fleet" or \
+        str(ini.get("fleet", "")).strip().lower() in ("1", "true", "yes", "on")
+    cfg.fleet_members = pick(args.fleet_members, "fleet_members")
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

@@ -30,6 +30,11 @@ Actions:
                 (default 777 — a signature tests use to tell the fake
                 host's responses from the CPU fallback engine's)
     slow:S      sleep S seconds (heartbeats continue), then ok
+    slow-after:K[:S]  chunks 0..K-1 answer instantly, every later chunk
+                sleeps S seconds (default 1.0) first — a member that
+                *becomes* a straggler, for load-balancing tests (the
+                chunk counter persists in --state, so the K-th chunk is
+                counted across respawns like everything else)
     hang        keep heartbeating, never reply — killed at the deadline
     stall       stop ALL output and sleep forever — killed by the
                 heartbeat watchdog
@@ -58,6 +63,12 @@ and exactly which positions each incarnation was asked to search.
 Engine-config flags of the real host (--backend/--weights/--depth/
 --helpers/--refill/--partials/--hb-interval) are accepted and echoed,
 never interpreted.
+
+`--latency-ms N` adds a fixed N-millisecond service delay to EVERY
+chunk before its scripted action runs (heartbeats continue). Unlike the
+one-shot `slow:S` action this models a member's steady-state speed, so
+fleet load-balancing and scaling tests (tests/test_fleet.py, bench.py
+fleet_scaling) can build deterministically asymmetric members.
 """
 from __future__ import annotations
 
@@ -94,6 +105,9 @@ NAMED_SCRIPTS = {
     "die-mid-chunk": {"chunks": ["die-after:2", "partial-ok"]},
     "hang-mid-chunk": {"chunks": ["hang-at:1", "partial-ok"]},
     "dup-partial": {"chunks": ["dup-partial"]},
+    # fast for one chunk, then a 1s straggler — the fleet planner must
+    # shift load off it (tests/test_fleet.py least-backlog spread)
+    "straggler": {"chunks": ["slow-after:1:1.0"]},
 }
 
 
@@ -169,6 +183,9 @@ def main(argv=None) -> int:
     p.add_argument("--helpers", type=int, default=None)
     p.add_argument("--refill", type=int, default=None)
     p.add_argument("--partials", type=int, default=1)
+    # fixed per-chunk service delay (fleet asymmetric-member tests);
+    # applied before every chunk's scripted action, heartbeats continue
+    p.add_argument("--latency-ms", type=float, default=0.0)
     # clock-sync fault injection (obs/trace.py ClockSync): report a
     # monotonic clock running S seconds BEHIND the real one in hb/ready
     # `mono` fields, and stream a synthetic child trace ring stamped on
@@ -247,7 +264,10 @@ def main(argv=None) -> int:
         positions = msg.get("chunk", {}).get("positions", [])
         fps = [wire_position_fingerprint(wp) for wp in positions]
         echo({"t": "go", "positions": len(positions), "fps": fps})
-        action = _action(script.get("chunks"), state.bump("chunks"), "ok")
+        chunk_idx = state.bump("chunks")
+        action = _action(script.get("chunks"), chunk_idx, "ok")
+        if args.latency_ms > 0:
+            time.sleep(args.latency_ms / 1000.0)
 
         if args.trace_skew is not None:
             # one synthetic span per chunk, stamped on the SKEWED clock
@@ -328,6 +348,12 @@ def main(argv=None) -> int:
             cp = FAKE_CP
             if action.startswith("slow:"):
                 time.sleep(float(action.split(":", 1)[1]))
+            elif action.startswith("slow-after:"):
+                parts = action.split(":")
+                after = int(parts[1])
+                delay = float(parts[2]) if len(parts) > 2 else 1.0
+                if chunk_idx >= after:
+                    time.sleep(delay)
             elif action.startswith("ok:"):
                 cp = int(action.split(":", 1)[1])
             elif action.startswith("partial-ok"):

@@ -243,6 +243,12 @@ class SupervisedEngine(ChunkSubmit):
         # poison positions (by content fingerprint), routed individually
         # to the CPU fallback for the rest of this process's life
         self._quarantine: Set[str] = set()
+        # position-ack observer (fleet/coordinator.py): called with
+        # (fp, wire_response) for every partial accepted into the
+        # journal, so an upstream dispatcher can keep its own
+        # exactly-once ledger even when this engine's ladder gives up
+        # and the journaled results above never leave go_multiple
+        self.on_partial = None
         self._ladder_active = False
         self._stats_recorder = stats_recorder
         # trace timeline (obs/trace.py): when FISHNET_TPU_TRACE_DIR is
@@ -256,6 +262,22 @@ class SupervisedEngine(ChunkSubmit):
         # child-monotonic → parent-monotonic mapping; rebuilt per child
         # incarnation in _spawn (each process has its own epoch)
         self._clock = obs_trace.ClockSync()
+
+    # --------------------------------------------------------------- health
+
+    @property
+    def breaker_open(self) -> bool:
+        """Public breaker state for upstream health checks (the fleet
+        coordinator drains members whose engines degraded to fallback)."""
+        return self._breaker_open
+
+    @property
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the child's last frame, or None with no live
+        child — the fleet's per-member liveness signal."""
+        if self.proc is None or self._down_noted:
+            return None
+        return max(time.monotonic() - self._last_frame, 0.0)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -587,6 +609,11 @@ class SupervisedEngine(ChunkSubmit):
         self._journal[fp] = wire
         self.stats.partials += 1
         self._last_partial = time.monotonic()
+        if self.on_partial is not None:
+            try:
+                self.on_partial(fp, wire)
+            except Exception as e:  # observer bugs must not kill delivery
+                self.logger.warn(f"on_partial observer failed: {e}")
 
     # ------------------------------------------------------------- watchdog
 

@@ -1,0 +1,46 @@
+"""Fleet coordinator: one work queue over N engine hosts.
+
+The reference fishnet is itself a fleet — thousands of independent
+clients work-stealing from one lichess queue — while everything below
+this package assumes one machine. `FleetCoordinator` (coordinator.py)
+closes that gap: it implements the `Engine` protocol (via the
+`ChunkSubmit` mixin, engine/session.py), so the lichess client,
+`fishnet-tpu serve` and bench feed it unchanged, and it spreads the
+positions of every chunk across N members by least-backlog admission.
+
+Members come in two kinds (member.py):
+
+- **local** — a `SupervisedEngine`-managed host child on this machine
+  (engine/supervisor.py; the scripted fakehost rides the same path for
+  tests/chaos/bench);
+- **remote** — another machine's `fishnet-tpu serve` endpoint, spoken
+  to over the PR-11 HTTP protocol (remote.py reuses serve/protocol.py
+  as the wire, so a fleet spans machines with zero new serde).
+
+Exactly-once under member loss: in-flight positions are journaled by
+`position_fingerprint` (client/ipc.py), acks stream in per position
+(the supervisor's `on_partial` hook), and when a member dies only its
+un-acked work is re-dispatched to survivors — strictly fewer
+re-searches than resubmitting the chunk. Repeated-poison fingerprints
+are quarantined fleet-wide to the CPU fallback. Member trace rings
+merge onto one timeline (obs/trace.py) and member counters fold into
+one metrics registry (obs/metrics.py), so the whole fleet is one
+Perfetto timeline and one Prometheus endpoint.
+
+`python -m fishnet_tpu fleet` serves the coordinator over HTTP
+standalone; `serve`/`run` grow a `--fleet` engine factory. docs/fleet.md
+has the topology, the member-spec grammar and the failure ladder.
+"""
+from .coordinator import FleetCoordinator, FleetStats, LossEvent
+from .member import FleetMember, make_local_member, members_from_specs
+from .remote import HttpEngine
+
+__all__ = [
+    "FleetCoordinator",
+    "FleetMember",
+    "FleetStats",
+    "HttpEngine",
+    "LossEvent",
+    "make_local_member",
+    "members_from_specs",
+]

@@ -1,0 +1,483 @@
+"""The fleet coordinator: one Engine over N members, exactly-once.
+
+`FleetCoordinator.go_multiple(chunk)` splits the chunk's positions
+across the available members (least-backlog greedy: each position goes
+to the member with the fewest outstanding positions, counting what this
+very planning round already assigned) and dispatches each member its
+sub-chunk concurrently. Everything above — `EngineSession`, the lichess
+client workers, `fishnet-tpu serve`, bench — feeds it unchanged because
+it speaks the same `Engine` protocol via `ChunkSubmit`.
+
+Exactly-once under member loss, the invariant the chaos gate
+(tools/chaos.py --scenario fleet-member-loss) enforces:
+
+- every position is keyed by `position_fingerprint` and recorded in the
+  member's in-flight ledger before its sub-chunk dispatches;
+- acks stream back per position (local members mirror their partial
+  journal through `SupervisedEngine.on_partial`; remote members answer
+  whole sub-chunks, which ack every position at once);
+- when a member's dispatch raises `EngineError` (child SIGKILLed, HTTP
+  endpoint gone), the coordinator harvests the acked results it already
+  holds and re-dispatches ONLY the un-acked remainder to survivors — a
+  strict subset of the member's in-flight set whenever at least one ack
+  landed, and always strictly fewer re-searches than resubmitting the
+  chunk;
+- exactly one loss event per member death: cooldown (`down_until`),
+  one `fleet.member-loss` trace instant, one loss counter increment,
+  one flight-recorder dump, one `LossEvent` appended to `loss_log`;
+- a fingerprint that is un-acked across `POISON_THRESHOLD` distinct
+  losses is quarantined fleet-wide (it killed two different members —
+  the position is the poison, not the host) and answered by the CPU
+  fallback; later chunks pre-route it before it can touch a member.
+
+Re-dispatch rounds are bounded by FISHNET_TPU_FLEET_REDISPATCH_MAX;
+a lost member sits out FISHNET_TPU_FLEET_LOSS_WINDOW seconds before
+the planner will consider it again (its own supervisor respawn backoff
+still applies underneath).
+
+Observability folds to one pane: member trace rings already merge into
+the shared module recorder (each local supervisor absorbs its child's
+spans with a per-member clock sync), the coordinator adds
+`fleet.dispatch` spans and loss instants around them, and
+`fold_metrics()` mirrors the fleet ledger plus every local member's
+`SupervisorStats` into the metrics registry — one Perfetto timeline,
+one Prometheus endpoint for the whole fleet.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import asdict, dataclass, field, replace
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..client.ipc import (
+    Chunk,
+    PositionResponse,
+    WorkPosition,
+    position_fingerprint,
+    responses_from_wire,
+)
+from ..client.logger import Logger
+from ..client.wire import EngineFlavor
+from ..engine.base import EngineError
+from ..engine.session import ChunkSubmit
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+from ..utils import settings
+from .member import FleetMember
+
+# distinct member losses with the same fingerprint un-acked before the
+# position is declared poison and quarantined fleet-wide
+POISON_THRESHOLD = 2
+
+_Pair = Tuple[str, WorkPosition]  # (fingerprint, position)
+
+
+@dataclass
+class LossEvent:
+    """One member death, as the exactly-once ledger saw it."""
+
+    member: str
+    reason: str
+    inflight_fps: Tuple[str, ...]  # what the member held when it died
+    acked_fps: Tuple[str, ...]  # harvested — NOT re-searched
+    redispatched_fps: Tuple[str, ...]  # un-acked remainder, re-dispatched
+
+
+@dataclass
+class FleetStats:
+    """Coordinator counters; absorbed into the metrics registry by
+    `fold_metrics` (same shape-contract as SupervisorStats)."""
+
+    chunks_ok: int = 0
+    dispatches: int = 0  # member sub-chunk dispatches
+    dispatched_positions: int = 0
+    acks_harvested: int = 0  # answered from a dead member's acks
+    redispatches: int = 0  # positions re-dispatched after a loss
+    redispatch_rounds: int = 0
+    losses: int = 0
+    quarantined: int = 0  # fingerprints quarantined fleet-wide
+    quarantine_routed: int = 0  # positions answered by the fallback
+
+
+class FleetCoordinator(ChunkSubmit):
+    """`Engine` protocol over N `FleetMember`s."""
+
+    _submit_flavor = EngineFlavor.TPU
+
+    def __init__(
+        self,
+        members: List[FleetMember],
+        *,
+        logger: Optional[Logger] = None,
+        redispatch_max: Optional[int] = None,
+        loss_window: Optional[float] = None,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        fallback_factory=None,
+    ) -> None:
+        if not members:
+            raise ValueError("a fleet needs at least one member")
+        self.members = list(members)
+        self.logger = logger or Logger()
+        self.redispatch_max = (
+            settings.get_int("FISHNET_TPU_FLEET_REDISPATCH_MAX")
+            if redispatch_max is None else int(redispatch_max)
+        )
+        self.loss_window = float(
+            settings.get_int("FISHNET_TPU_FLEET_LOSS_WINDOW")
+            if loss_window is None else loss_window
+        )
+        self.registry = registry or obs_metrics.REGISTRY
+        self.fallback_factory = fallback_factory
+        self.stats = FleetStats()
+        self.loss_log: List[LossEvent] = []
+        self._quarantine: Set[str] = set()
+        self._poison: Dict[str, int] = {}
+        self._fallback = None
+        self._closing = False
+        self._trace_dir = settings.get_str("FISHNET_TPU_TRACE_DIR")
+        if self._trace_dir and obs_trace.RECORDER is None:
+            obs_trace.install_from_settings("fleet")
+
+    # -------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        """Start every local member's engine host concurrently. A member
+        that fails to come up enters loss cooldown instead of failing
+        the fleet — survivors carry the queue, the planner retries it
+        after the window."""
+
+        async def _start_one(member: FleetMember):
+            start = getattr(member.engine, "start", None)
+            if start is None:
+                return  # remote members have no child to spawn
+            try:
+                await start()
+            except EngineError as e:
+                self._note_loss(member, f"start failed: {e}", [], {})
+
+        await asyncio.gather(*(_start_one(m) for m in self.members))
+        live = [m for m in self.members if m.available()]
+        if not live:
+            raise EngineError("fleet: no member came up")
+        self.logger.info(
+            f"fleet: {len(live)}/{len(self.members)} member(s) ready"
+        )
+
+    async def close(self) -> None:
+        self._closing = True
+        engines = [m.engine for m in self.members]
+        if self._fallback is not None:
+            engines.append(self._fallback)
+            self._fallback = None
+        await asyncio.gather(
+            *(e.close() for e in engines), return_exceptions=True
+        )
+
+    def begin_drain(self, member_name: Optional[str] = None) -> None:
+        """Stop planning work onto a member (or all of them); in-flight
+        sub-chunks finish normally. The autoscaling story in
+        docs/fleet.md drains a member before removing it."""
+        for m in self.members:
+            if member_name is None or m.name == member_name:
+                m.draining = True
+
+    # ---------------------------------------------------------------- health
+
+    def health(self) -> dict:
+        now = time.monotonic()
+        members = [m.health(now) for m in self.members]
+        return {
+            "members": members,
+            "members_live": sum(1 for h in members if h["available"]),
+            "quarantined": len(self._quarantine),
+            "losses": self.stats.losses,
+        }
+
+    def fold_metrics(self) -> None:
+        """Mirror the fleet ledger into the metrics registry: fleet
+        gauges + per-member backlog/inflight, and every local member's
+        SupervisorStats under its own prefix — the single-endpoint
+        contract (one Prometheus scrape sees the whole fleet)."""
+        reg = self.registry
+        now = time.monotonic()
+        reg.gauge(
+            "fishnet_fleet_members_live",
+            "Fleet members currently eligible for work",
+        ).set(sum(1 for m in self.members if m.available(now)))
+        reg.gauge(
+            "fishnet_fleet_members_total", "Configured fleet members"
+        ).set(len(self.members))
+        reg.absorb_totals("fishnet_fleet", asdict(self.stats))
+        for m in self.members:
+            reg.gauge(
+                f"fishnet_fleet_backlog_{m.name}",
+                "Positions dispatched to this member, not yet answered",
+            ).set(m.backlog)
+            reg.gauge(
+                f"fishnet_fleet_inflight_{m.name}",
+                "Positions in this member's exactly-once ledger",
+            ).set(len(m.inflight))
+            reg.counter(
+                f"fishnet_fleet_dispatch_positions_total_{m.name}",
+                "Positions ever dispatched to this member",
+            ).set_total(m.dispatched_positions)
+            reg.counter(
+                f"fishnet_fleet_losses_total_{m.name}",
+                "Member-loss events for this member",
+            ).set_total(m.losses)
+            stats = getattr(m.engine, "stats", None)
+            if stats is not None and m.kind == "local":
+                reg.absorb_totals(
+                    f"fishnet_fleet_member_{m.name}", asdict(stats)
+                )
+
+    # --------------------------------------------------------------- dispatch
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        pairs: List[_Pair] = [
+            (position_fingerprint(wp), wp) for wp in chunk.positions
+        ]
+        results: Dict[str, PositionResponse] = {}
+        # fleet-wide quarantine pre-route: known-poison positions never
+        # touch a member again — straight to the CPU fallback
+        pending: List[_Pair] = []
+        for fp, wp in pairs:
+            if fp in self._quarantine:
+                self.stats.quarantine_routed += 1
+                results[fp] = await self._go_quarantined(chunk, wp)
+            else:
+                pending.append((fp, wp))
+        if pending:
+            await self._dispatch_all(chunk, pending, results)
+        missing = [fp for fp, _ in pairs if fp not in results]
+        if missing:  # _dispatch_all raises before this can happen
+            raise EngineError(
+                f"fleet dropped {len(missing)} position(s) "
+                f"of batch {chunk.work.id}"
+            )
+        self.stats.chunks_ok += 1
+        self.fold_metrics()
+        return [results[fp] for fp, _ in pairs]
+
+    async def _dispatch_all(
+        self,
+        chunk: Chunk,
+        pending: List[_Pair],
+        results: Dict[str, PositionResponse],
+    ) -> None:
+        """Dispatch rounds until every pending position has a result.
+        Round 1 is the normal spread; later rounds re-dispatch only what
+        a lost member left un-acked."""
+        rounds = 0
+        while pending:
+            now = time.monotonic()
+            available = [m for m in self.members if m.available(now)]
+            if not available:
+                raise EngineError(
+                    "fleet: no live members "
+                    f"({len(pending)} position(s) stranded)"
+                )
+            plan = self._plan(pending, available)
+            # Admission bookkeeping is synchronous, BEFORE the dispatch
+            # tasks are scheduled: concurrent go_multiple() callers plan
+            # against each other's load only if the backlog is already
+            # visible when their own _plan runs. Ledger order matters
+            # too — in-flight is recorded before the engine sees the
+            # work, and stale acks from a previous incarnation of the
+            # same fingerprint are dropped so a leftover can never be
+            # satisfied by an old answer.
+            for member, assigned in plan:
+                member.backlog += len(assigned)
+                member.dispatched_positions += len(assigned)
+                self.stats.dispatches += 1
+                self.stats.dispatched_positions += len(assigned)
+                for fp, wp in assigned:
+                    member.acked.pop(fp, None)
+                    member.inflight[fp] = wp
+            leftovers = await asyncio.gather(
+                *(
+                    self._dispatch_member(member, chunk, assigned, results)
+                    for member, assigned in plan
+                )
+            )
+            pending = []
+            for leftover in leftovers:
+                for fp, wp in leftover:
+                    if fp in results:
+                        continue  # first answer won while we re-planned
+                    count = self._poison.get(fp, 0) + 1
+                    self._poison[fp] = count
+                    if count >= POISON_THRESHOLD:
+                        self._quarantine_fp(fp)
+                        self.stats.quarantine_routed += 1
+                        results[fp] = await self._go_quarantined(chunk, wp)
+                    else:
+                        pending.append((fp, wp))
+            if pending:
+                rounds += 1
+                if rounds > self.redispatch_max:
+                    raise EngineError(
+                        f"fleet: re-dispatch budget exhausted after "
+                        f"{rounds - 1} round(s); "
+                        f"{len(pending)} position(s) unanswered"
+                    )
+                self.stats.redispatch_rounds += 1
+                self.stats.redispatches += len(pending)
+                self.logger.warn(
+                    f"fleet: re-dispatching {len(pending)} un-acked "
+                    f"position(s) to survivors (round {rounds})"
+                )
+
+    def _plan(
+        self, pending: List[_Pair], available: List[FleetMember]
+    ) -> List[Tuple[FleetMember, List[_Pair]]]:
+        """Greedy least-backlog: positions land one at a time on the
+        member with the smallest backlog, counting this round's own
+        assignments — an idle fleet gets an even spread, a lopsided one
+        (slow member, straggler) gets topped up where there's room."""
+        load = {id(m): m.backlog for m in available}
+        assigned: Dict[int, List[_Pair]] = {id(m): [] for m in available}
+        for pair in pending:
+            member = min(available, key=lambda m: load[id(m)])
+            load[id(member)] += 1
+            assigned[id(member)].append(pair)
+        return [
+            (m, assigned[id(m)]) for m in available if assigned[id(m)]
+        ]
+
+    async def _dispatch_member(
+        self,
+        member: FleetMember,
+        chunk: Chunk,
+        assigned: List[_Pair],
+        results: Dict[str, PositionResponse],
+    ) -> List[_Pair]:
+        """One member's sub-chunk; returns the un-acked leftover (empty
+        on success). The caller has already charged this work to the
+        member's ledger (backlog, in-flight) — this method only runs the
+        engine call and settles the ledger in its `finally`."""
+        n = len(assigned)
+        sub = replace(chunk, positions=[wp for _, wp in assigned])
+        try:
+            with obs_trace.span(
+                "fleet.dispatch", "fleet", member=member.name, positions=n,
+                batch=str(chunk.work.id),
+            ):
+                responses = await member.engine.go_multiple(sub)
+            if len(responses) != n:
+                raise EngineError(
+                    f"fleet member {member.name} returned "
+                    f"{len(responses)} results for {n} positions"
+                )
+            for (fp, _), res in zip(assigned, responses):
+                results[fp] = res
+            return []
+        except EngineError as e:
+            # harvest what the member acked before dying: those
+            # positions are answered, not re-searched
+            acked: Dict[str, dict] = {}
+            for fp, _ in assigned:
+                wire = member.acked.get(fp)
+                if wire is not None and fp not in results:
+                    try:
+                        results[fp] = responses_from_wire(
+                            chunk.work, [wire]
+                        )[0]
+                        acked[fp] = wire
+                        self.stats.acks_harvested += 1
+                    except (KeyError, TypeError, ValueError) as bad:
+                        self.logger.warn(
+                            f"fleet: discarding malformed ack from "
+                            f"{member.name}: {bad}"
+                        )
+            leftover = [
+                (fp, wp) for fp, wp in assigned if fp not in results
+            ]
+            self._note_loss(member, str(e), [fp for fp, _ in assigned],
+                            acked, leftover)
+            return leftover
+        finally:
+            member.backlog -= n
+            for fp, _ in assigned:
+                member.inflight.pop(fp, None)
+                member.acked.pop(fp, None)
+
+    # ------------------------------------------------------------ loss/poison
+
+    def _note_loss(
+        self,
+        member: FleetMember,
+        reason: str,
+        inflight_fps: List[str],
+        acked: Dict[str, dict],
+        leftover: Optional[List[_Pair]] = None,
+    ) -> None:
+        """Exactly one breaker-visible event per member death: cooldown,
+        loss counters, trace instant, flight dump, LossEvent record."""
+        now = time.monotonic()
+        member.losses += 1
+        member.down_until = now + self.loss_window
+        self.stats.losses += 1
+        redisp = tuple(fp for fp, _ in (leftover or []))
+        event = LossEvent(
+            member=member.name,
+            reason=reason,
+            inflight_fps=tuple(inflight_fps),
+            acked_fps=tuple(acked),
+            redispatched_fps=redisp,
+        )
+        self.loss_log.append(event)
+        obs_trace.instant(
+            "fleet.member-loss", "fleet", member=member.name,
+            reason=reason, inflight=len(inflight_fps),
+            acked=len(acked), redispatched=len(redisp),
+        )
+        self.logger.error(
+            f"fleet: member {member.name} lost ({reason}); "
+            f"{len(acked)} ack(s) harvested, {len(redisp)} position(s) "
+            f"to re-dispatch; cooling down {self.loss_window:.0f}s"
+        )
+        self._flight_dump("member-loss", f"{member.name}: {reason}")
+
+    def _quarantine_fp(self, fp: str) -> None:
+        if fp in self._quarantine:
+            return
+        self._quarantine.add(fp)
+        self.stats.quarantined += 1
+        obs_trace.instant("fleet.quarantine", "fleet", fp=fp)
+        self.logger.error(
+            f"fleet: position {fp} un-acked across {POISON_THRESHOLD} "
+            "member losses — quarantined fleet-wide to the CPU fallback"
+        )
+
+    async def _go_quarantined(
+        self, chunk: Chunk, wp: WorkPosition
+    ) -> PositionResponse:
+        if self._fallback is None:
+            if self.fallback_factory is not None:
+                self._fallback = self.fallback_factory()
+            else:
+                from ..engine.pyengine import PyEngine
+
+                self._fallback = PyEngine()
+        responses = await self._fallback.go_multiple(
+            replace(chunk, positions=[wp])
+        )
+        if len(responses) != 1:
+            raise EngineError(
+                "fleet fallback returned a mismatched result count"
+            )
+        return responses[0]
+
+    def _flight_dump(self, slug: str, reason: str) -> None:
+        rec = obs_trace.RECORDER
+        if rec is None or not self._trace_dir:
+            return
+        rec.instant("flight-dump", "fleet", reason=reason)
+        try:
+            path = rec.flight_dump(self._trace_dir, slug)
+        except OSError as e:
+            self.logger.warn(f"fleet: flight-recorder dump failed: {e}")
+        else:
+            self.logger.warn(f"fleet: flight recorder dumped to {path}")

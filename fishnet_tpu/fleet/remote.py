@@ -1,0 +1,234 @@
+"""Engine-protocol client for a remote `fishnet-tpu serve` endpoint.
+
+A remote fleet member is just another machine running the PR-11 HTTP
+front-end (fishnet_tpu/serve/). This module conforms that endpoint to
+the `Engine` protocol (engine/base.py): `go_multiple(chunk)` maps the
+chunk onto one POST /analyse or /bestmove body built through
+serve/protocol.py's `request_to_json` — the same inverse-pair serde the
+server parses with — so the fleet spans machines with zero new wire
+format. Responses come back in request-position order as the pipe-wire
+PositionResponse form (results_to_json mirrors response_to_wire), and
+`responses_from_wire` rebuilds them after the chunk-protocol
+bookkeeping (position_index, url) this side kept is re-injected.
+
+Transport is asyncio streams end to end (lint rule conc-sock-in-loop:
+the coordinator's event loop must never block on a socket), one
+connection per request with `Connection: close` — the fleet's member
+loss detector wants failures to surface as exceptions on THIS dispatch,
+not poison a pooled connection for the next one. Every await is bounded
+by the chunk deadline via asyncio.wait_for.
+
+Node-budget note: the chunk's per-position budget survives the HTTP
+hop within floor-rounding (the serve side re-applies the 7/6 pre-scale
+that NodeLimit.get() undoes), so remote results match local ones
+whenever depth or deadline binds before the budget does — the parity
+contract tests/test_fleet.py pins.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import List, Optional, Tuple
+from urllib.parse import urlsplit
+
+from ..client.ipc import Chunk, PositionResponse, responses_from_wire
+from ..client.wire import AnalysisWork, MoveWork
+from ..engine.base import EngineError
+from ..engine.session import PRIORITY_BATCH, ChunkSubmit
+from ..serve.protocol import ServeRequest, request_to_json
+
+DEFAULT_TIMEOUT_S = 30.0
+MAX_RESPONSE_BYTES = 8 * 1024 * 1024
+
+
+def parse_member_url(url: str) -> Tuple[str, int]:
+    """'http://host:port' (or bare 'host:port') → (host, port)."""
+    if "//" not in url:
+        url = "http://" + url
+    parts = urlsplit(url)
+    if parts.scheme not in ("", "http"):
+        raise ValueError(
+            f"fleet member URL {url!r}: only plain http:// is spoken "
+            "(front a TLS proxy for anything routable)"
+        )
+    if not parts.hostname or not parts.port:
+        raise ValueError(f"fleet member URL {url!r} needs host and port")
+    return parts.hostname, parts.port
+
+
+def chunk_to_serve_request(chunk: Chunk, now: Optional[float] = None) -> dict:
+    """One chunk → one serve body (serve/protocol.py shape).
+
+    The timeout is the chunk's remaining deadline budget; the remote
+    admission controller stamps its own deadline from it, so the search
+    cutoff rides along instead of resetting at the hop.
+    """
+    work = chunk.work
+    if now is None:
+        now = time.monotonic()
+    timeout_ms = max(int((chunk.deadline - now) * 1000.0), 1)
+    positions = tuple(
+        (wp.root_fen, tuple(wp.moves)) for wp in chunk.positions
+    )
+    if isinstance(work, MoveWork):
+        req = ServeRequest(
+            kind="bestmove", positions=positions, id=str(work.id),
+            variant=chunk.variant, level=work.level.level,
+            timeout_ms=min(timeout_ms, 600_000),
+        )
+    else:
+        assert isinstance(work, AnalysisWork)
+        nodes = work.nodes.get(chunk.flavor.eval_flavor())
+        req = ServeRequest(
+            kind="analysis", positions=positions, id=str(work.id),
+            variant=chunk.variant, depth=work.depth, multipv=work.multipv,
+            nodes=max(min(nodes, 1_000_000_000), 1),
+            priority=PRIORITY_BATCH,
+            timeout_ms=min(timeout_ms, 600_000),
+        )
+    return request_to_json(req)
+
+
+class HttpEngine(ChunkSubmit):
+    """`Engine` over a remote serve endpoint; one POST per chunk."""
+
+    def __init__(self, url: str, timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.host, self.port = parse_member_url(url)
+        self.url = f"http://{self.host}:{self.port}"
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------- dispatch
+
+    async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
+        path = (
+            "/bestmove" if isinstance(chunk.work, MoveWork) else "/analyse"
+        )
+        body = chunk_to_serve_request(chunk)
+        budget = min(chunk.deadline - time.monotonic(), self.timeout_s)
+        if budget <= 0:
+            raise EngineError(
+                f"fleet member {self.url}: chunk deadline already passed"
+            )
+        status, payload = await self._round_trip("POST", path, body, budget)
+        if status != 200:
+            detail = payload.get("error", "") if isinstance(payload, dict) \
+                else ""
+            raise EngineError(
+                f"fleet member {self.url} answered HTTP {status} "
+                f"for batch {chunk.work.id}: {detail}"
+            )
+        results = payload.get("results") if isinstance(payload, dict) else None
+        if not isinstance(results, list) or \
+                len(results) != len(chunk.positions):
+            raise EngineError(
+                f"fleet member {self.url} returned "
+                f"{len(results) if isinstance(results, list) else '?'} "
+                f"results for {len(chunk.positions)} positions"
+            )
+        # results_to_json strips the chunk-protocol bookkeeping (the HTTP
+        # answer orders by the request's positions list); restore it from
+        # the chunk this side still holds before rebuilding responses
+        for wp, wire in zip(chunk.positions, results):
+            if not isinstance(wire, dict):
+                raise EngineError(
+                    f"fleet member {self.url} sent a malformed result"
+                )
+            wire["position_index"] = wp.position_index
+            wire["url"] = wp.url
+        try:
+            return responses_from_wire(chunk.work, results)
+        except (KeyError, TypeError, ValueError) as e:
+            raise EngineError(
+                f"fleet member {self.url} sent a malformed result: {e}"
+            ) from e
+
+    async def healthz(self, timeout_s: float = 2.0) -> dict:
+        """The serve endpoint's liveness/occupancy summary — the fleet's
+        remote heartbeat (queued/inflight feed backlog accounting)."""
+        status, payload = await self._round_trip(
+            "GET", "/healthz", None, timeout_s
+        )
+        if status != 200 or not isinstance(payload, dict):
+            raise EngineError(
+                f"fleet member {self.url} healthz answered HTTP {status}"
+            )
+        return payload
+
+    async def close(self) -> None:
+        pass  # connection-per-request: nothing pooled to tear down
+
+    # ------------------------------------------------------------ transport
+
+    async def _round_trip(
+        self, method: str, path: str, body_obj: Optional[dict],
+        timeout_s: float,
+    ) -> Tuple[int, object]:
+        try:
+            return await asyncio.wait_for(
+                self._round_trip_inner(method, path, body_obj),
+                timeout=timeout_s,
+            )
+        except asyncio.TimeoutError:
+            raise EngineError(
+                f"fleet member {self.url}: no answer within {timeout_s:.1f}s"
+            ) from None
+        except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+            raise EngineError(
+                f"fleet member {self.url}: connection failed: {e}"
+            ) from e
+
+    async def _round_trip_inner(
+        self, method: str, path: str, body_obj: Optional[dict]
+    ) -> Tuple[int, object]:
+        payload = b"" if body_obj is None else \
+            json.dumps(body_obj).encode("utf-8")
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            head = (
+                f"{method} {path} HTTP/1.1\r\n"
+                f"Host: {self.host}:{self.port}\r\n"
+                "Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + payload)
+            await writer.drain()
+            status_line = await reader.readline()
+            parts = status_line.decode("latin-1", "replace").split()
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise EngineError(
+                    f"fleet member {self.url} sent a malformed status line"
+                )
+            status = int(parts[1])
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode("latin-1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        length = int(value.strip())
+                    except ValueError:
+                        raise EngineError(
+                            f"fleet member {self.url} sent a bad "
+                            "Content-Length"
+                        ) from None
+            if length > MAX_RESPONSE_BYTES:
+                raise EngineError(
+                    f"fleet member {self.url} response too large ({length}B)"
+                )
+            raw = await reader.readexactly(length) if length > 0 else b""
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass  # close raced the peer's reset; already closed
+        try:
+            return status, json.loads(raw.decode("utf-8")) if raw else {}
+        except (ValueError, UnicodeDecodeError) as e:
+            raise EngineError(
+                f"fleet member {self.url} sent a non-JSON body: {e}"
+            ) from e

@@ -33,12 +33,14 @@ that true:
                        run_in_executor instead
 
 Scopes: the timeout/lock rules run on the process-boundary modules
-(supervisor, host, uci, workers, queue) and on fishnet_tpu/serve/ (the
-HTTP front-end is a process boundary too); the except rules run on all
-of client/, engine/ and serve/ (kernels and utils keep their own idioms
-— e.g. compile_cache deliberately degrades to "no cache" on any error).
-The sock-in-loop rule runs on serve/ only — the one package whose code
-lives inside a single shared event loop.
+(supervisor, host, uci, workers, queue), on fishnet_tpu/serve/ (the
+HTTP front-end is a process boundary too), and on fishnet_tpu/fleet/
+(the coordinator fans out across N member processes/machines); the
+except rules run on all of client/, engine/, serve/ and fleet/
+(kernels and utils keep their own idioms — e.g. compile_cache
+deliberately degrades to "no cache" on any error).
+The sock-in-loop rule runs on serve/ and fleet/ — the packages whose
+code lives inside a single shared event loop.
 Narrow handlers (`except OSError: pass` around best-effort logging) are
 deliberately not flagged — the rules target *broad* swallowing.
 
@@ -75,15 +77,17 @@ BLOCK_SCOPE = (
     "fishnet_tpu/client/workers.py",
     "fishnet_tpu/client/queue.py",
     "fishnet_tpu/serve",
+    "fishnet_tpu/fleet",
 )
 
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine",
-                "fishnet_tpu/serve")
+                "fishnet_tpu/serve", "fishnet_tpu/fleet")
 
-# the serving package runs inside ONE shared event loop: a blocking
-# socket call in an async def stalls every tenant at once
-SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve",)
+# these packages run inside ONE shared event loop: a blocking socket
+# call in an async def stalls every tenant (serve) or every member
+# dispatch (fleet) at once
+SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve", "fishnet_tpu/fleet")
 
 # call targets that block the thread: raw socket ops, sync HTTP
 # clients, and the sleep that should have been asyncio.sleep. Matched

@@ -329,7 +329,13 @@ async def run_serve(cfg) -> int:
         EngineFlavor.TPU if cfg.backend == "tpu" else EngineFlavor.OFFICIAL
     )
     engine = factory(flavor)
-    if cfg.backend == "tpu":
+    if getattr(cfg, "fleet", False):
+        # fleet front door: the coordinator spawns its local members
+        # (remote ones need no warmup) before the listener opens
+        logger.info("serve: starting fleet members ...")
+        await engine.start()
+        logger.info("serve: fleet coordinator ready.")
+    elif cfg.backend == "tpu":
         logger.info("serve: warming up TPU engine (compiling search program) ...")
         if cfg.supervisor:
             await engine.start()

@@ -321,6 +321,35 @@ SETTINGS: Tuple[Setting, ...] = (
             "many seconds, flushes stats, then exits.",
     ),
     Setting(
+        name="FISHNET_TPU_FLEET_MEMBERS",
+        kind="str",
+        default="local*1",
+        doc="Fleet member specs, comma-separated (fishnet_tpu/fleet/): "
+            "'local' or 'local*N' for SupervisedEngine-managed host "
+            "children on this machine, 'http://HOST:PORT' (or bare "
+            "HOST:PORT) for a remote `fishnet-tpu serve` endpoint. "
+            "Used when the coordinator is started without an explicit "
+            "--fleet-members.",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_REDISPATCH_MAX",
+        kind="int",
+        default="3",
+        doc="Re-dispatch rounds the fleet coordinator may spend per "
+            "chunk after member losses before the chunk fails; each "
+            "round re-sends only the lost member's un-acked positions "
+            "to survivors (exactly-once ledger, fleet/coordinator.py).",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_LOSS_WINDOW",
+        kind="int",
+        default="30",
+        doc="Seconds a lost fleet member sits out of admission after a "
+            "member-loss event before the least-backlog planner "
+            "considers it again (its supervisor's own respawn backoff "
+            "still applies underneath).",
+    ),
+    Setting(
         name="FISHNET_TPU_COMPILE_CACHE",
         kind="str",
         default="",

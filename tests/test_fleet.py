@@ -1,0 +1,487 @@
+"""Fleet coordinator tests: N members behind one Engine, exactly-once.
+
+Round-12 acceptance coverage (ISSUE 12), all on CPU with the scriptable
+fake host or PyEngine — no JAX:
+
+- N-member results are bit-identical to a single-member run;
+- the least-backlog planner routes around a busy member;
+- a member SIGKILLed mid-chunk re-dispatches exactly its un-acked
+  in-flight positions to survivors (strictly fewer re-searches than a
+  chunk resubmit), with one loss event;
+- a fingerprint that kills two different members is quarantined
+  fleet-wide and pre-routed to the CPU fallback on later chunks;
+- a remote (HTTP) member answers identically to the same engine driven
+  directly — serve/protocol.py round-trips the work faithfully;
+- the merged metrics registry and trace ring tie out to the per-member
+  ledgers (one Prometheus endpoint, one timeline).
+"""
+import asyncio
+import json
+import sys
+import time
+
+import pytest
+
+from fishnet_tpu.client.backoff import RandomizedBackoff
+from fishnet_tpu.client.ipc import (
+    Chunk,
+    WorkPosition,
+    position_fingerprint,
+    response_to_wire,
+)
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.fakehost import FAKE_CP
+from fishnet_tpu.engine.pyengine import PyEngine
+from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+from fishnet_tpu.fleet.member import make_local_member, members_from_specs
+from fishnet_tpu.obs import trace as obs_trace
+from fishnet_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.faultinject
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def fake_cmd(script, state_path, hb=0.05, echo=None, extra=()):
+    cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", json.dumps(script),
+        "--state", str(state_path),
+        "--hb-interval", str(hb),
+    ]
+    if echo is not None:
+        cmd += ["--echo", str(echo)]
+    return cmd + list(extra)
+
+
+def fake_member(name, script, tmp_path, echo=None, extra=()):
+    return make_local_member(
+        name,
+        host_cmd=fake_cmd(script, tmp_path / f"{name}.json",
+                          echo=echo, extra=extra),
+        logger=Logger(verbose=0),
+        hb_interval=0.05,
+        hb_timeout=1.0,
+        backoff=RandomizedBackoff(max_s=0.05),
+    )
+
+
+def make_chunk(n=4, ttl=30.0, moves=(), depth=1,
+               flavor=EngineFlavor.TPU, batch="fleetjob"):
+    work = AnalysisWork(
+        id=batch,
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=ttl, depth=depth, multipv=None,
+    )
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=START, moves=list(moves))
+        for i in range(n)
+    ]
+    return Chunk(work=work, deadline=time.monotonic() + ttl,
+                 variant="standard", flavor=flavor, positions=positions)
+
+
+def comparable(res):
+    wire = response_to_wire(res)
+    return {k: wire[k]
+            for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+
+
+def read_echo(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------ member specs
+
+
+def test_member_spec_grammar():
+    members = members_from_specs(
+        "local*2, http://h1:9670, h2:9671",
+        local_factory=lambda name: FleetMember(name=name, engine=object()),
+        logger=Logger(verbose=0),
+    )
+    assert [(m.name, m.kind) for m in members] == [
+        ("local0", "local"), ("local1", "local"),
+        ("h1:9670", "remote"), ("h2:9671", "remote"),
+    ]
+    with pytest.raises(ValueError):
+        members_from_specs("", logger=Logger(verbose=0))
+    with pytest.raises(ValueError):
+        members_from_specs("local*0", logger=Logger(verbose=0))
+    with pytest.raises(ValueError):
+        members_from_specs("https://h:1", logger=Logger(verbose=0))
+    with pytest.raises(ValueError):
+        members_from_specs("h:1,h:1", logger=Logger(verbose=0))
+
+
+# ------------------------------------------------------------- bit identity
+
+
+def test_n_member_results_bit_identical_to_single_member():
+    """Splitting a chunk over 2 members changes nothing about any
+    position's answer: per-position node budgets are independent of the
+    sub-chunk shape, so the fleet adds no search-visible state."""
+
+    async def scenario():
+        chunk = make_chunk(n=4, depth=2, flavor=EngineFlavor.OFFICIAL,
+                           moves=["e2e4"])
+        direct = await PyEngine(max_depth=2).go_multiple(chunk)
+
+        coord = FleetCoordinator(
+            [FleetMember(name=f"py{i}", engine=PyEngine(max_depth=2))
+             for i in range(2)],
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            loss_window=0.1,
+        )
+        try:
+            fleet = await coord.go_multiple(make_chunk(
+                n=4, depth=2, flavor=EngineFlavor.OFFICIAL,
+                moves=["e2e4"]))
+        finally:
+            await coord.close()
+
+        assert [r.position_index for r in fleet] == [0, 1, 2, 3]
+        for a, b in zip(fleet, direct):
+            assert comparable(a) == comparable(b)
+        # the spread was real: both members searched
+        assert all(m.dispatched_positions == 2 for m in coord.members)
+
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------------- least-backlog plan
+
+
+def test_least_backlog_routes_around_busy_member(tmp_path):
+    """While the slow member digests its chunk, new chunks must land on
+    the idle one — backlog, not round-robin, drives admission."""
+    echo_slow = tmp_path / "slow.jsonl"
+    echo_fast = tmp_path / "fast.jsonl"
+
+    async def scenario():
+        coord = FleetCoordinator(
+            [
+                fake_member("slow", {"chunks": ["ok"]}, tmp_path,
+                            echo=echo_slow, extra=["--latency-ms", "400"]),
+                fake_member("fast", {"chunks": ["ok"]}, tmp_path,
+                            echo=echo_fast),
+            ],
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            loss_window=0.1,
+        )
+        try:
+            await coord.start()
+            # ties break in member order, so the first chunk occupies
+            # the slow member ...
+            first = asyncio.ensure_future(coord.go_multiple(
+                make_chunk(n=1, moves=["e2e4"], batch="job-a")))
+            await asyncio.sleep(0.1)
+            # ... and while its backlog is up, later chunks must avoid
+            # it. Staggered so the fast member's backlog drains between
+            # them — admission charges are visible synchronously, so a
+            # concurrent pair would tie at backlog 1 and split.
+            second = await coord.go_multiple(
+                make_chunk(n=1, moves=["d2d4"], batch="job-b"))
+            third = await coord.go_multiple(
+                make_chunk(n=1, moves=["c2c4"], batch="job-c"))
+            later = [second, third]
+            await first
+            for responses in later:
+                assert responses[0].scores.best().value == FAKE_CP
+        finally:
+            await coord.close()
+
+        slow_gos = [r for r in read_echo(echo_slow) if r["t"] == "go"]
+        fast_gos = [r for r in read_echo(echo_fast) if r["t"] == "go"]
+        assert len(slow_gos) == 1  # only the chunk that made it busy
+        assert len(fast_gos) == 2  # everything submitted while it was
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------- member loss ledger
+
+
+def test_member_loss_redispatches_exactly_the_unacked_subset(tmp_path):
+    """3 members, 6 positions (2 each); m0 acks one position then dies.
+    Exactly one response per position, exactly one loss event, and the
+    survivors re-search only m0's un-acked position — 7 positions
+    touched fleet-wide, not 12."""
+    echos = {f"m{i}": tmp_path / f"m{i}.jsonl" for i in range(3)}
+
+    async def scenario():
+        members = [
+            fake_member("m0", {"chunks": ["die-after:1", "ok"]},
+                        tmp_path, echo=echos["m0"]),
+            fake_member("m1", {"chunks": ["ok"]}, tmp_path,
+                        echo=echos["m1"]),
+            fake_member("m2", {"chunks": ["ok"]}, tmp_path,
+                        echo=echos["m2"]),
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(),
+            redispatch_max=3, loss_window=0.2,
+        )
+        try:
+            await coord.start()
+            chunk = make_chunk(n=6)
+            responses = await coord.go_multiple(chunk)
+            # exactly-once, in request order, all on the engine path
+            assert [r.position_index for r in responses] == list(range(6))
+            assert [r.scores.best().value for r in responses] == \
+                [FAKE_CP] * 6
+        finally:
+            await coord.close()
+
+        assert coord.stats.losses == 1
+        assert len(coord.loss_log) == 1
+        ev = coord.loss_log[0]
+        assert ev.member == "m0"
+        redisp = set(ev.redispatched_fps)
+        inflight = set(ev.inflight_fps)
+        assert len(inflight) == 2  # the 2-position sub-chunk
+        assert set(ev.acked_fps) == inflight - redisp
+        assert redisp < inflight  # strict subset: the ack was harvested
+        assert coord.stats.redispatches == 1
+        assert coord.stats.acks_harvested == 1
+
+        # strictly fewer re-searches than resubmitting the chunk: the
+        # members collectively received 6 + 1 positions, and the
+        # re-dispatched fingerprint went to a survivor, not m0
+        gos = {name: [r for r in read_echo(path) if r["t"] == "go"]
+               for name, path in echos.items()}
+        total = sum(g["positions"] for gs in gos.values() for g in gs)
+        assert total == 6 + len(redisp) < 12
+        assert len(gos["m0"]) == 1
+        survivor_fps = [fp for name in ("m1", "m2")
+                        for g in gos[name] for fp in g["fps"]]
+        assert all(fp in survivor_fps for fp in redisp)
+
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------------------- quarantine
+
+
+def test_poison_fingerprint_quarantined_fleet_wide(tmp_path):
+    """A position whose fingerprint kills two different members is the
+    poison, not the hosts: it gets quarantined fleet-wide, answered by
+    the CPU fallback, and pre-routed on every later chunk so it never
+    touches a member again."""
+
+    async def scenario():
+        chunk = make_chunk(n=3)
+        # planning is deterministic: [p0,p2] -> first member, [p1] ->
+        # second; make the LAST of the first member's positions the
+        # poison so its earlier position is acked before the crash
+        poison = position_fingerprint(chunk.positions[2])
+        script = {"chunks": [f"crash-on-fp:{poison}"]}
+        members = [
+            fake_member("ma", script, tmp_path),
+            fake_member("mb", script, tmp_path),
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(),
+            redispatch_max=4, loss_window=0.05,
+        )
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(chunk)
+            assert [r.position_index for r in responses] == [0, 1, 2]
+            cps = [r.scores.best().value for r in responses]
+            assert cps[0] == FAKE_CP and cps[1] == FAKE_CP
+            assert cps[2] != FAKE_CP  # fallback answered the poison
+            assert coord.stats.losses == 2
+            assert coord.stats.quarantined == 1
+            assert coord.stats.quarantine_routed == 1
+
+            # second chunk, same fingerprints: pre-routed, no new loss
+            chunk2 = make_chunk(n=3, batch="fleetjob2")
+            responses2 = await coord.go_multiple(chunk2)
+            cps2 = [r.scores.best().value for r in responses2]
+            assert cps2[0] == FAKE_CP and cps2[1] == FAKE_CP
+            assert cps2[2] != FAKE_CP
+            assert coord.stats.losses == 2  # unchanged
+            assert coord.stats.quarantine_routed == 2
+        finally:
+            await coord.close()
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------------ remote member
+
+
+def test_remote_http_member_parity_with_local_engine():
+    """A chunk through a remote member (HttpEngine -> ServeApp over
+    PyEngine) answers identically to the same chunk through that engine
+    directly: serve/protocol.py preserves the work definition across
+    the hop (depth binds; the node budget survives within rounding)."""
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.serve.server import ServeApp
+
+    async def scenario():
+        app = ServeApp(
+            EngineSession(PyEngine(max_depth=2),
+                          flavor=EngineFlavor.OFFICIAL),
+            registry=MetricsRegistry(),
+            logger=Logger(verbose=0),
+        )
+        host, port = await app.start("127.0.0.1", 0)
+        coord = FleetCoordinator(
+            members_from_specs(f"http://{host}:{port}",
+                               logger=Logger(verbose=0)),
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            loss_window=0.1,
+        )
+        try:
+            chunk = make_chunk(n=3, depth=2, flavor=EngineFlavor.OFFICIAL,
+                               moves=["e2e4"])
+            remote = await coord.go_multiple(chunk)
+            direct = await PyEngine(max_depth=2).go_multiple(
+                make_chunk(n=3, depth=2, flavor=EngineFlavor.OFFICIAL,
+                           moves=["e2e4"]))
+            assert [r.position_index for r in remote] == [0, 1, 2]
+            for a, b in zip(remote, direct):
+                assert comparable(a) == comparable(b)
+        finally:
+            await coord.close()
+            await app.drain_and_stop()
+
+    asyncio.run(scenario())
+
+
+def test_remote_member_error_surfaces_as_member_loss(tmp_path):
+    """An unreachable HTTP member is a member loss like any other: the
+    dispatch raises EngineError inside the coordinator, the work lands
+    on a survivor, and the dead endpoint enters cooldown."""
+
+    async def scenario():
+        members = members_from_specs(
+            # port 1 on loopback: connection refused, instantly
+            "http://127.0.0.1:1,local*1",
+            local_factory=lambda name: fake_member(
+                name, {"chunks": ["ok"]}, tmp_path),
+            logger=Logger(verbose=0),
+        )
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(),
+            redispatch_max=3, loss_window=5.0,
+        )
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=4))
+            assert [r.position_index for r in responses] == [0, 1, 2, 3]
+            assert all(r.scores.best().value == FAKE_CP
+                       for r in responses)
+        finally:
+            await coord.close()
+
+        assert coord.stats.losses == 1
+        assert coord.loss_log[0].member == "127.0.0.1:1"
+        # remote members have no partial stream: the whole sub-chunk
+        # was un-acked, so the whole sub-chunk re-dispatched
+        ev = coord.loss_log[0]
+        assert set(ev.redispatched_fps) == set(ev.inflight_fps)
+        dead = coord.members[0]
+        assert not dead.available()  # cooling down, out of admission
+
+    asyncio.run(scenario())
+
+
+# --------------------------------------------------------- merged obs pane
+
+
+def test_merged_metrics_and_trace_tie_out(tmp_path, monkeypatch):
+    """One registry and one trace ring describe the whole fleet: the
+    folded gauges/counters equal the per-member ledgers, and the ring
+    holds clock-synced spans from every member process."""
+    monkeypatch.setenv("FISHNET_TPU_TRACE_DIR", str(tmp_path / "traces"))
+    obs_trace.uninstall()
+
+    async def scenario():
+        members = [
+            fake_member("m0", {"chunks": ["die-after:1", "ok"]}, tmp_path,
+                        extra=["--trace-skew", "5.0"]),
+            fake_member("m1", {"chunks": ["ok"]}, tmp_path,
+                        extra=["--trace-skew", "0.0"]),
+            fake_member("m2", {"chunks": ["ok"]}, tmp_path,
+                        extra=["--trace-skew", "2.5"]),
+        ]
+        registry = MetricsRegistry()
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0), registry=registry,
+            redispatch_max=3, loss_window=0.2,
+        )
+        t0_us = obs_trace.now_us()
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=6))
+            assert len(responses) == 6
+        finally:
+            events = (obs_trace.RECORDER.snapshot()
+                      if obs_trace.RECORDER else [])
+            await coord.close()
+        t1_us = obs_trace.now_us()
+
+        # ---- metrics: the one registry equals the per-member ledgers
+        snap = registry.snapshot()
+        assert snap["fishnet_fleet_members_total"] == 3
+        assert sum(
+            snap[f"fishnet_fleet_dispatch_positions_total_{m.name}"]
+            for m in members
+        ) == coord.stats.dispatched_positions
+        assert sum(
+            snap[f"fishnet_fleet_losses_total_{m.name}"] for m in members
+        ) == coord.stats.losses == 1
+        assert snap["fishnet_fleet_redispatches"] == \
+            coord.stats.redispatches
+        # local members' own SupervisorStats fold in under their prefix
+        assert snap["fishnet_fleet_member_m0_deaths"] >= 1
+        assert snap["fishnet_fleet_member_m1_chunks_ok"] >= 1
+
+        # ---- trace: spans from all three member processes, shifted
+        # onto the parent clock despite 5.0s/2.5s child skews
+        searches = [e for e in events if e.get("name") == "fake.search"]
+        assert len({e.get("pid") for e in searches}) == 3
+        slack = 1_000_000
+        for e in searches:
+            assert t0_us - slack <= e["ts"] <= t1_us + slack
+        names = {e.get("name") for e in events}
+        assert "fleet.dispatch" in names
+        assert "fleet.member-loss" in names
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        obs_trace.uninstall()
+
+
+# -------------------------------------------------------------- no members
+
+
+def test_all_members_lost_fails_loudly(tmp_path):
+    """When every member is down and cooling, the chunk fails with an
+    EngineError naming the stranded positions — never a silent drop."""
+
+    async def scenario():
+        coord = FleetCoordinator(
+            [fake_member("m0", {"chunks": ["crash:9"]}, tmp_path)],
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            redispatch_max=2, loss_window=60.0,
+        )
+        try:
+            with pytest.raises(EngineError, match="no live members"):
+                await coord.go_multiple(make_chunk(n=2))
+        finally:
+            await coord.close()
+        assert coord.stats.losses == 1
+
+    asyncio.run(scenario())

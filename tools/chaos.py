@@ -14,12 +14,22 @@ Examples:
     python -m tools.chaos --script '{"chunks": ["stall", "ok"]}' --chunks 3
     python -m tools.chaos --list
     python -m tools.chaos --scenario --format=github   # CI acceptance run
+    python -m tools.chaos --scenario fleet-member-loss # fleet CI gate
 
-`--scenario` runs the round-9 session-recovery acceptance ladder
-end-to-end (kill-mid-chunk replay, hang-at-segment progress kill,
-crash-on-fingerprint quarantine) and exits non-zero on any lost or
-duplicated PositionResponse, on a full-chunk re-search after a partial
-kill, or on quarantine routing the wrong position.
+`--scenario` (default `ladder`) runs the round-9 session-recovery
+acceptance ladder end-to-end (kill-mid-chunk replay, hang-at-segment
+progress kill, crash-on-fingerprint quarantine) and exits non-zero on
+any lost or duplicated PositionResponse, on a full-chunk re-search
+after a partial kill, or on quarantine routing the wrong position.
+
+`--scenario fleet-member-loss` is the fleet acceptance gate (ISSUE 12):
+3 fakehost-backed members, one SIGKILLed mid-chunk — every position
+must answer exactly once on the engine path, the re-dispatched set must
+be a strict subset of the dead member's in-flight positions (acked work
+is harvested, not re-searched), exactly one loss event must be
+recorded, and the merged flight-recorder dump must carry spans from all
+three member processes on one clock-synced timeline despite their
+deliberately skewed clocks.
 """
 from __future__ import annotations
 
@@ -275,6 +285,169 @@ async def scenario(args) -> int:
     return 0
 
 
+async def fleet_scenario(args) -> int:
+    """Fleet member-loss acceptance gate (ISSUE 12). Three local
+    fakehost members with deliberately skewed child clocks; member m0
+    dies after acking 1 of its positions mid-chunk. Verifies the
+    exactly-once ledger (harvest acks, re-dispatch only the un-acked
+    remainder to survivors), the one-loss-event contract, and that the
+    merged flight dump holds all three members' spans on the parent
+    timeline."""
+    import os
+
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs import trace as obs_trace
+    from tools import trace_report
+
+    problems = []
+    n = 6
+    with tempfile.TemporaryDirectory(prefix="chaos-fleet-") as tmp:
+        trace_dir = f"{tmp}/traces"
+        # set before any member constructs: SupervisedEngine.__init__
+        # reads the registry and installs the process-global recorder
+        os.environ["FISHNET_TPU_TRACE_DIR"] = trace_dir
+
+        def member(name, script, skew):
+            # distinct non-zero skews: if the per-member ClockSync were
+            # broken, these spans would land seconds off the timeline
+            return make_local_member(
+                name,
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", json.dumps(script),
+                    "--state", f"{tmp}/{name}.json",
+                    "--hb-interval", "0.05",
+                    "--trace-skew", str(skew),
+                ],
+                logger=Logger(verbose=0),
+                hb_interval=0.05,
+                hb_timeout=1.0,
+                backoff=RandomizedBackoff(max_s=0.05),
+            )
+
+        print("== fleet scenario: 3 members, m0 dies after 1 ack ==")
+        members = [
+            member("m0", {"chunks": ["die-after:1", "ok"]}, 5.0),
+            member("m1", {"chunks": ["ok"]}, 0.0),
+            member("m2", {"chunks": ["ok"]}, 2.5),
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=2),
+            redispatch_max=3, loss_window=0.2,
+        )
+        t0_us = obs_trace.now_us()
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(1, 30.0, n))
+            _check_exactly_once(responses, n, problems, "fleet-member-loss")
+            if any(r.scores.best().value != FAKE_CP for r in responses):
+                problems.append(
+                    "fleet-member-loss: a position was answered off the "
+                    "engine path (fallback leaked into the fleet)"
+                )
+            if coord.stats.losses != 1 or len(coord.loss_log) != 1:
+                problems.append(
+                    f"fleet-member-loss: expected exactly one loss event, "
+                    f"got losses={coord.stats.losses} "
+                    f"log={len(coord.loss_log)}"
+                )
+            if coord.loss_log:
+                ev = coord.loss_log[0]
+                redisp = set(ev.redispatched_fps)
+                inflight = set(ev.inflight_fps)
+                unacked = inflight - set(ev.acked_fps)
+                if not redisp:
+                    problems.append(
+                        "fleet-member-loss: nothing re-dispatched — the "
+                        "dead member's un-acked work was dropped"
+                    )
+                if redisp != unacked:
+                    problems.append(
+                        "fleet-member-loss: re-dispatched set != the dead "
+                        f"member's un-acked in-flight set ({redisp} vs "
+                        f"{unacked})"
+                    )
+                if not redisp < inflight:
+                    problems.append(
+                        "fleet-member-loss: re-dispatched set is not a "
+                        "strict subset of the member's in-flight set — "
+                        "acked work was re-searched"
+                    )
+                if len(redisp) >= n:
+                    problems.append(
+                        "fleet-member-loss: re-dispatched as much as a "
+                        "full chunk resubmit"
+                    )
+        except EngineError as e:
+            problems.append(f"fleet-member-loss: chunk failed outright: {e}")
+        finally:
+            print(f"fleet stats: {coord.stats}")
+            rec = obs_trace.RECORDER
+            if rec is not None:
+                # final merged dump with every member's absorbed spans
+                # (the member-loss dump is written mid-flight and may
+                # race the survivors' trace frames)
+                rec.flight_dump(trace_dir, "fleet-scenario")
+            await coord.close()
+        t1_us = obs_trace.now_us()
+        obs_trace.uninstall()
+        del os.environ["FISHNET_TPU_TRACE_DIR"]
+
+        loss_dumps = sorted(Path(trace_dir).glob("trace-member-loss-*.json"))
+        if not loss_dumps:
+            problems.append(
+                "fleet-member-loss: the loss left no member-loss flight "
+                f"dump in {trace_dir}"
+            )
+        dumps = sorted(Path(trace_dir).glob("trace-fleet-scenario-*.json"))
+        if not dumps:
+            problems.append(
+                f"fleet-member-loss: no merged fleet dump in {trace_dir}"
+            )
+        else:
+            print(f"\nmerged dump: {dumps[-1].name}")
+            events = trace_report.load_events(str(dumps[-1]))
+            searches = [e for e in events if e.get("name") == "fake.search"]
+            pids = {e.get("pid") for e in searches}
+            if len(pids) < 3:
+                problems.append(
+                    "fleet-member-loss: merged dump has fake.search spans "
+                    f"from {len(pids)} member process(es), expected 3"
+                )
+            # clock-sync: with 5.0s/2.5s child skews, an unsynced span
+            # would sit seconds outside the parent's monotonic window
+            slack_us = 1_000_000
+            for e in searches:
+                if not (t0_us - slack_us <= e["ts"] <= t1_us + slack_us):
+                    problems.append(
+                        "fleet-member-loss: a member span (pid "
+                        f"{e.get('pid')}) landed {e['ts']} outside the "
+                        f"parent window [{t0_us}, {t1_us}] — clock sync "
+                        "failed"
+                    )
+                    break
+            names = {e.get("name") for e in events}
+            for expected in ("fleet.dispatch", "fleet.member-loss"):
+                if expected not in names:
+                    problems.append(
+                        f"fleet-member-loss: merged dump is missing the "
+                        f"coordinator's {expected!r} marker"
+                    )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos fleet scenario::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos fleet scenario: exactly-once under member loss, merged "
+          "3-member timeline verified")
+    return 0
+
+
 async def trace_smoke(args) -> int:
     """CI flight-recorder smoke (ISSUE 10): a chaos-induced child death
     with tracing on must leave a merged supervisor+host dump that loads
@@ -375,9 +548,13 @@ def main(argv=None) -> int:
     p.add_argument("--hb-timeout", type=float, default=2.0)
     p.add_argument("--breaker-threshold", type=int, default=3)
     p.add_argument("--probe-interval", type=float, default=5.0)
-    p.add_argument("--scenario", action="store_true",
-                   help="run the session-recovery acceptance ladder and "
-                        "exit non-zero on any delivery violation")
+    p.add_argument("--scenario", nargs="?", const="ladder", default=None,
+                   choices=["ladder", "fleet-member-loss"],
+                   help="run an acceptance scenario and exit non-zero on "
+                        "any delivery violation: `ladder` (default when "
+                        "the flag is bare) is the session-recovery "
+                        "ladder, `fleet-member-loss` kills one of 3 "
+                        "fleet members mid-chunk")
     p.add_argument("--trace-smoke", action="store_true",
                    help="kill a child mid-chunk with tracing on and "
                         "verify the merged flight dump parses")
@@ -388,8 +565,10 @@ def main(argv=None) -> int:
         for name, script in NAMED_SCRIPTS.items():
             print(f"{name:14s} {json.dumps(script)}")
         return 0
-    if args.scenario:
+    if args.scenario == "ladder":
         return asyncio.run(scenario(args))
+    if args.scenario == "fleet-member-loss":
+        return asyncio.run(fleet_scenario(args))
     if args.trace_smoke:
         return asyncio.run(trace_smoke(args))
     return asyncio.run(replay(args))
