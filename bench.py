@@ -836,6 +836,104 @@ def run_fleet_stage(timeout: float) -> dict | None:
     }
 
 
+def run_coldstart_stage(timeout: float) -> dict | None:
+    """Cold-start A/B row (AOT program assets, fishnet_tpu/aot/):
+    time-to-first-result of a FRESH engine process, plain JIT vs booted
+    against a pre-packed bundle. Three subprocesses: `fishnet_tpu pack`
+    builds the bundle, then two tools/aot_smoke.py --child runs (one
+    with FISHNET_TPU_AOT=0, one against the bundle) each boot, warm up,
+    and search 16 lanes to the first result. Both children disable the
+    persistent XLA cache so the A/B isolates the bundle itself — with
+    the disk cache on, the JIT side is half-warm too and the row
+    under-reports what a fresh autoscaled replica actually saves.
+    BENCH_COLDSTART_PLY sets the stack height (default 8, toy; 32 for
+    the production shape — pack time grows with it)."""
+    import shutil
+    import tempfile
+
+    ply = os.environ.get("BENCH_COLDSTART_PLY", "8")
+    here = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(here, "tools", "aot_smoke.py")
+    tmp = tempfile.mkdtemp(prefix="bench-coldstart-")
+    store = os.path.join(tmp, "store")
+    env = {
+        **os.environ,
+        "FISHNET_TPU_MAX_PLY": ply,
+        "FISHNET_TPU_WARMUP_BUCKETS": "16",
+        "FISHNET_TPU_HELPERS": "1",
+        "FISHNET_TPU_NO_COMPILE_CACHE": "1",
+    }
+    env.pop("FISHNET_TPU_TRACE_DIR", None)
+
+    def run_one(tag: str, argv: list, extra: dict,
+                budget: float) -> tuple[float, int] | None:
+        t1 = time.monotonic()
+        try:
+            r = subprocess.run(
+                argv, cwd=here, env={**env, **extra},
+                capture_output=True, text=True, timeout=budget,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench cold_start: {tag} timed out",
+                  file=sys.stderr, flush=True)
+            return None
+        if r.returncode != 0:
+            tail = (r.stdout or "").splitlines()[-3:]
+            print(f"bench cold_start: {tag} exited {r.returncode}: {tail}",
+                  file=sys.stderr, flush=True)
+            return None
+        return time.monotonic() - t1, r.returncode
+
+    try:
+        t0 = time.monotonic()
+        packed = run_one(
+            "pack",
+            [sys.executable, "-m", "fishnet_tpu", "pack",
+             "--aot-bundle", store, "--no-conf"],
+            {"FISHNET_TPU_AOT": "0"}, timeout,
+        )
+        if packed is None:
+            return None
+        pack_s = packed[0]
+        budget = max(60.0, timeout - (time.monotonic() - t0))
+        cold = run_one(
+            "jit-cold",
+            [sys.executable, child, "--child",
+             os.path.join(tmp, "cold.json")],
+            {"FISHNET_TPU_AOT": "0"}, budget,
+        )
+        budget = max(60.0, timeout - (time.monotonic() - t0))
+        warm = run_one(
+            "aot-warm",
+            [sys.executable, child, "--child",
+             os.path.join(tmp, "warm.json")],
+            {"FISHNET_TPU_AOT": "1", "FISHNET_TPU_AOT_DIR": store},
+            budget,
+        )
+        if cold is None or warm is None:
+            return None
+        with open(os.path.join(tmp, "warm.json")) as f:
+            warm_rep = json.load(f)
+        if warm_rep.get("stats", {}).get("misses", 0):
+            # a missing program means the row is measuring a partial
+            # bundle, not warmup-free boot — report it as a failure
+            print(f"bench cold_start: warm boot missed: "
+                  f"{warm_rep['stats']}", file=sys.stderr, flush=True)
+            return None
+        return {
+            "pack_s": round(pack_s, 2),
+            "cold_first_result_s": round(cold[0], 2),
+            "warm_first_result_s": round(warm[0], 2),
+            "speedup": round(cold[0] / max(warm[0], 1e-9), 2),
+            "programs": warm_rep.get("aot", {}).get("programs", 0),
+            "loads": warm_rep.get("stats", {}).get("loads", 0),
+            "max_ply": int(ply),
+            "lanes": 16,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def device_preflight(timeout: float = 120.0) -> bool:
     """Can a fresh process see the TPU at all? A wedged/down tunnel makes
     jax init hang, which would otherwise burn one full stage timeout per
@@ -1044,6 +1142,24 @@ def main() -> None:
             res = run_fleet_stage(min(stage_timeout, remaining))
             matrix["fleet_scaling"] = res
             print("bench config fleet_scaling: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # cold-start A/B row (AOT program assets, round 13): time-to-first-
+    # result of a fresh engine subprocess, plain JIT vs a pre-packed
+    # bundle. Opt-in (BENCH_COLDSTART=1) — the pack leg recompiles the
+    # full program set once more, which a tight-budget ramp shouldn't pay
+    if os.environ.get("BENCH_COLDSTART", "0") not in ("", "0", "false",
+                                                      "no"):
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120.0:
+            print("bench: skipping cold_start (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["cold_start"] = None
+        else:
+            res = run_coldstart_stage(min(stage_timeout * 2, remaining))
+            matrix["cold_start"] = res
+            print("bench config cold_start: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
     if matrix:

@@ -1,0 +1,23 @@
+"""AOT program assets: pack, ship, and preload compiled search programs.
+
+The reference ships ready-to-run engine *binaries* in an archive
+unpacked at startup (assets.rs); our executable is the XLA program.
+This package inverts the same trick for programs: `pack` runs the real
+warmup/stream paths under an exporting registry and serializes every
+compiled executable (jax.experimental.serialize_executable) into a
+content-addressed bundle; `warm` installs a bundle on a host; a booted
+replica then reaches its first segment dispatch with zero XLA
+compilations, loading executables from disk instead of compiling.
+
+Layout:
+  keys.py     — canonical store fingerprint + per-program keys, and the
+                explicit compat-rejection diff.
+  registry.py — the on-disk store, the AotProgram wrapper around the
+                hot jits, load→deserialize→call plumbing, JIT fallback.
+  pack.py     — bundle build (`python -m fishnet_tpu pack`) and install
+                (`python -m fishnet_tpu warm`).
+
+See docs/aot.md for the bundle format and the fallback ladder.
+"""
+
+from . import keys, registry  # noqa: F401

@@ -1,0 +1,153 @@
+"""Canonical fingerprints for AOT program assets.
+
+Two layers of keying:
+
+* **Store fingerprint** — everything that invalidates *every* artifact
+  at once: jax/jaxlib version, backend + device kind + device count,
+  and the raw value of every search-visible settings knob
+  (AOT_KEY_SETTINGS). A bundle packed under one fingerprint is never
+  loaded under another; `diff_fingerprints` names the exact fields that
+  diverged so the rejection is explicit, not a silent cache miss.
+
+* **Program key** — one compiled executable: entry-point name, the
+  static (compile-time) arguments, and the abstract signature of the
+  dynamic arguments (shape/dtype/weak_type per leaf plus the pytree
+  structure). Width buckets, variants, mesh shapes and scalar
+  weak-typing all land in this layer naturally, because they change
+  either a static argument or a leaf aval.
+
+Everything here is pure computation over strings/avals — no I/O, no
+serialization. registry.py owns the store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from ..utils import settings
+
+# Settings whose raw values key the store fingerprint: every knob that
+# changes the traced search program or its numerics. Adding a
+# search-visible setting without listing it here means stale bundles
+# keep loading after the knob flips — list liberally.
+AOT_KEY_SETTINGS = (
+    "FISHNET_TPU_MAX_PLY",
+    "FISHNET_TPU_ASPIRATION",
+    "FISHNET_TPU_SELECT_UPDATES",
+    "FISHNET_TPU_NO_PRUNING",
+    "FISHNET_TPU_DTYPE",
+    "FISHNET_TPU_EXPERIMENTAL_INT8",
+)
+
+
+def _jaxlib_version() -> str:
+    try:
+        import jaxlib
+
+        return str(getattr(jaxlib, "__version__", ""))
+    except ImportError:
+        return ""
+
+
+def store_fingerprint() -> Dict[str, Any]:
+    """The compatibility envelope of this process's compiled programs."""
+    try:
+        devs = jax.devices()
+    except Exception:
+        devs = []
+    return {
+        "jax": jax.__version__,
+        "jaxlib": _jaxlib_version(),
+        "backend": jax.default_backend(),
+        "device_kind": devs[0].device_kind if devs else "",
+        "device_count": len(devs),
+        "settings": {
+            name: settings.raw(name) or "" for name in AOT_KEY_SETTINGS
+        },
+    }
+
+
+def fingerprint_digest(fp: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(fp, sort_keys=True).encode("utf-8")
+    ).hexdigest()
+
+
+def diff_fingerprints(ours: Optional[Dict[str, Any]],
+                      theirs: Optional[Dict[str, Any]]) -> List[str]:
+    """Field-by-field mismatch list — the explicit compat-rejection path.
+
+    Empty list means compatible. Each entry reads
+    ``field: ours=... bundle=...`` so a rejected bundle is diagnosable
+    from one log line.
+    """
+
+    def flat(fp: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        fp = fp or {}
+        out = {k: v for k, v in fp.items() if k != "settings"}
+        for k, v in (fp.get("settings") or {}).items():
+            out[f"settings.{k}"] = v
+        return out
+
+    a, b = flat(ours), flat(theirs)
+    return [
+        f"{k}: ours={a.get(k)!r} bundle={b.get(k)!r}"
+        for k in sorted(set(a) | set(b))
+        if a.get(k) != b.get(k)
+    ]
+
+
+def _leaf_sig(x: Any) -> List[Any]:
+    try:
+        from jax.api_util import shaped_abstractify
+
+        a = shaped_abstractify(x)
+        return [
+            [int(d) for d in a.shape],
+            a.dtype.name,
+            bool(getattr(a, "weak_type", False)),
+        ]
+    except Exception:
+        # Non-abstractifiable leaf (opaque host object): key on its type
+        # so distinct kinds never alias; such programs simply never
+        # share an artifact across leaf types.
+        return ["opaque", type(x).__name__]
+
+
+def abstract_signature(dynamics: Any) -> str:
+    """JSON aval signature of a dynamic-argument pytree.
+
+    shape + dtype + weak_type per leaf and the stringified treedef —
+    exactly what jit keys its own executable cache on, so two calls
+    share an artifact iff jit would have shared a compilation.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(dynamics)
+    return json.dumps(
+        {"tree": str(treedef), "leaves": [_leaf_sig(x) for x in leaves]},
+        sort_keys=True,
+    )
+
+
+def static_signature(statics: Dict[str, Any],
+                     extra_static: Optional[Dict[str, Any]]) -> str:
+    items = {name: repr(v) for name, v in statics.items()}
+    for name, v in (extra_static or {}).items():
+        items[f"~{name}"] = repr(v)
+    return json.dumps(items, sort_keys=True)
+
+
+def program_key(entry: str, statics: Dict[str, Any],
+                extra_static: Optional[Dict[str, Any]],
+                dynamics: Any) -> Tuple[str, Dict[str, str]]:
+    """(sha256 hex key, manifest metadata) for one executable."""
+    stat = static_signature(statics, extra_static)
+    avals = abstract_signature(dynamics)
+    h = hashlib.sha256()
+    for part in (entry, stat, avals):
+        h.update(part.encode("utf-8"))
+        h.update(b"\x00")
+    return h.hexdigest(), {"entry": entry, "statics": stat, "avals": avals}

@@ -1,0 +1,214 @@
+"""Build and install AOT program bundles.
+
+`pack` is warmup-as-a-build-step: it installs an *exporting* registry,
+builds a real TpuEngine and runs the exact code paths a booted replica
+runs — bucket warmup, the deep move-job program, variant warmup, and a
+small refill stream — so every program key in the bundle matches the
+runtime call forms bit-for-bit (same arg trees, same weak types, same
+statics). The resulting directory is the bundle: manifest.json plus
+content-addressed compressed executables, mirroring assets.py's
+packaged-weights story but for programs.
+
+`warm` installs a bundle on a host: fingerprint-checks it against the
+local process (explicit field-by-field rejection on skew), re-verifies
+every artifact hash, and copies it into the live AOT directory.
+
+CLI (dispatched from client/app.py main):
+
+    python -m fishnet_tpu pack  [--aot-bundle OUT]  # default: live dir
+    python -m fishnet_tpu warm  --aot-bundle SRC [--aot-dir DEST]
+
+Run `pack` under the same environment the replica boots with (same
+FISHNET_TPU_* knobs, same jax, same device topology) — the fingerprint
+enforces it at load time anyway; matching up front avoids building a
+bundle no replica can use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils import settings
+from . import keys, registry
+
+
+def _log_to(logger: Optional[Callable[[str], None]]):
+    import sys
+
+    if logger is not None:
+        return logger
+    return lambda msg: print(msg, file=sys.stderr, flush=True)
+
+
+def _stream_warmup(engine, log: Callable[[str], None]) -> bool:
+    """Compile the stream-form programs warmup never touches.
+
+    The LaneScheduler's refill path calls the SAME jits with different
+    aval shapes than chunk-serial warmup: a (B,) tt_gen array on the
+    segment, the merge-splice program, and the full-array init form.
+    Stream a few positions through the smallest bucket with N > width so
+    a refill boundary actually fires; the program keys this exports are
+    exactly what a refill-enabled boot dispatches first.
+    """
+    if not engine.refill:
+        return False
+    if engine.mesh is not None and not engine.mesh_refill:
+        return False
+    import jax.numpy as jnp
+
+    from ..chess.position import Position
+    from ..engine.tpu import LANE_BUCKETS, MAX_PLY
+    from ..ops import search as search_ops
+    from ..ops.board import from_position, stack_boards
+
+    width = engine._pad(min(LANE_BUCKETS))
+    n = width + 2  # > width: forces at least one refill + merge
+    roots = stack_boards([from_position(Position.initial())] * n)
+    out = search_ops.search_stream(
+        engine.params, roots,
+        np.ones(n, np.int32), np.full(n, 64, np.int32),
+        max_ply=MAX_PLY, width=width,
+        tt=engine._scratch_tt(), mesh=engine.mesh,
+        prefer_deep_store=engine.helper_lanes > 1,
+    )
+    done = int(np.asarray(out["done"]).sum()) if "done" in out else n
+    log(f"pack: stream programs exported (width {width}, {done}/{n} done)")
+    return True
+
+
+def pack(out_dir: Optional[str] = None,
+         logger: Optional[Callable[[str], None]] = None,
+         engine_kwargs: Optional[Dict] = None) -> Dict:
+    """Build a bundle at out_dir (default: the live AOT directory)."""
+    log = _log_to(logger)
+    root = (
+        out_dir
+        or settings.get_str("FISHNET_TPU_AOT_DIR")
+        or registry.default_dir()
+    )
+    if registry.REGISTRY is not None and not registry.REGISTRY.export:
+        # a read-only registry from an earlier engine in this process
+        # would shadow the exporter — replace it explicitly
+        registry.uninstall()
+    reg = registry.install(root, export=True, logger=log)
+    log(
+        f"pack: exporting into {reg.dir} "
+        f"(fingerprint {reg.digest[:12]}, backend "
+        f"{reg.fingerprint['backend']}/{reg.fingerprint['device_kind']})"
+    )
+
+    from ..engine.tpu import TpuEngine
+
+    engine = TpuEngine(**(engine_kwargs or {}))
+    covers: List[str] = list(engine.warmup(None, log) or [])
+    if engine.warmup_variants(log):
+        covers.append("variants")
+    if _stream_warmup(engine, log):
+        covers.append("stream")
+    reg.flush()
+    reg.set_covers(covers)
+    rep = reg.report()
+    log(
+        f"pack: bundle ready — {rep['programs']} programs, covers "
+        f"{','.join(rep['covers']) or 'nothing'}, {reg.dir}"
+    )
+    return rep
+
+
+def verify_bundle(bundle_dir: str) -> Dict:
+    """Load + integrity-check a bundle directory; returns its manifest.
+
+    Raises ValueError naming the failure: missing manifest, version
+    skew, fingerprint mismatch against this process (field-by-field),
+    or an artifact whose sha256 does not match its manifest entry.
+    """
+    man_path = os.path.join(bundle_dir, registry.MANIFEST_NAME)
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            man = json.load(f)
+    except OSError as e:
+        raise ValueError(f"bundle has no readable manifest: {e}") from e
+    if man.get("version") != registry.MANIFEST_VERSION:
+        raise ValueError(
+            f"bundle manifest version {man.get('version')!r} != "
+            f"{registry.MANIFEST_VERSION}"
+        )
+    ours = keys.store_fingerprint()
+    diff = keys.diff_fingerprints(ours, man.get("fingerprint"))
+    if diff:
+        raise ValueError(
+            "bundle fingerprint is incompatible with this process: "
+            + "; ".join(diff)
+        )
+    for key, entry in (man.get("programs") or {}).items():
+        path = os.path.join(bundle_dir, "blobs", key + ".bin")
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise ValueError(f"artifact {key[:12]} unreadable: {e}") from e
+        if hashlib.sha256(blob).hexdigest() != entry.get("sha256"):
+            raise ValueError(f"artifact {key[:12]} fails its sha256 check")
+    return man
+
+
+def warm(bundle_dir: str, dest_root: Optional[str] = None,
+         logger: Optional[Callable[[str], None]] = None) -> Dict:
+    """Verify a bundle and install it under the live AOT directory."""
+    log = _log_to(logger)
+    bundle_dir = os.path.abspath(os.path.expanduser(bundle_dir))
+    # accept either a fingerprint directory or a store root holding one
+    if not os.path.isfile(os.path.join(bundle_dir, registry.MANIFEST_NAME)):
+        ours12 = keys.fingerprint_digest(keys.store_fingerprint())[:12]
+        nested = os.path.join(bundle_dir, ours12)
+        if os.path.isfile(os.path.join(nested, registry.MANIFEST_NAME)):
+            bundle_dir = nested
+    man = verify_bundle(bundle_dir)
+    root = (
+        dest_root
+        or settings.get_str("FISHNET_TPU_AOT_DIR")
+        or registry.default_dir()
+    )
+    digest12 = keys.fingerprint_digest(man["fingerprint"])[:12]
+    dest = os.path.join(os.path.abspath(os.path.expanduser(root)), digest12)
+    if os.path.abspath(bundle_dir) != dest:
+        if os.path.isdir(dest):
+            shutil.rmtree(dest)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copytree(bundle_dir, dest)
+    n = len(man.get("programs") or {})
+    log(
+        f"warm: installed {n} programs (covers "
+        f"{','.join(man.get('covers') or []) or 'nothing'}) into {dest}"
+    )
+    return {"programs": n, "covers": man.get("covers") or [], "dir": dest}
+
+
+def main_pack(cfg) -> int:
+    """`python -m fishnet_tpu pack` entry (cfg: client/configure.py)."""
+    try:
+        pack(getattr(cfg, "aot_bundle", None))
+        return 0
+    except Exception as e:
+        print(f"pack failed: {e}", flush=True)
+        return 1
+
+
+def main_warm(cfg) -> int:
+    """`python -m fishnet_tpu warm` entry (cfg: client/configure.py)."""
+    bundle = getattr(cfg, "aot_bundle", None)
+    if not bundle:
+        print("warm: --aot-bundle BUNDLE_DIR is required", flush=True)
+        return 2
+    try:
+        warm(bundle, getattr(cfg, "aot_dir", None))
+        return 0
+    except Exception as e:
+        print(f"warm failed: {e}", flush=True)
+        return 1

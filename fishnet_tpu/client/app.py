@@ -259,13 +259,25 @@ async def run(cfg: Config) -> int:
                 else:
                     await asyncio.to_thread(engine.warmup, None, logger.info)
                     logger.info("TPU engine ready (all lane buckets compiled).")
-                    # variant programs compile in the background; dispatches
-                    # interleave behind the engine lock, so standard chunks
-                    # flow immediately while variant chunks stop racing
-                    # their deadlines within the first few minutes
-                    asyncio.ensure_future(
-                        asyncio.to_thread(engine.warmup_variants, logger.info)
-                    )
+                    from ..aot import registry as aot_registry
+
+                    if aot_registry.warm_covers("variants"):
+                        # same skip as engine/host.py: compiling would
+                        # silently mask AOT bundle misses
+                        logger.info(
+                            "Variant programs preloaded from AOT bundle."
+                        )
+                    else:
+                        # variant programs compile in the background;
+                        # dispatches interleave behind the engine lock, so
+                        # standard chunks flow immediately while variant
+                        # chunks stop racing their deadlines within the
+                        # first few minutes
+                        asyncio.ensure_future(
+                            asyncio.to_thread(
+                                engine.warmup_variants, logger.info
+                            )
+                        )
                 break
             except Exception as e:
                 logger.warn(f"TPU warmup attempt {attempt + 1} failed: {e}")
@@ -382,6 +394,13 @@ def main(argv=None) -> int:
             run_name="__main__",
         )
         return 0
+    if cfg.command in ("pack", "warm"):
+        # AOT program assets (fishnet_tpu/aot/): `pack` compiles and
+        # serializes every hot search program into a bundle; `warm`
+        # installs a bundle so the next boot loads instead of compiling
+        from ..aot.pack import main_pack, main_warm
+
+        return main_pack(cfg) if cfg.command == "pack" else main_warm(cfg)
     if cfg.command in ("serve", "fleet"):
         # the analysis-serving front-end (fishnet_tpu/serve/): many
         # concurrent HTTP tenants multiplex into the same lane pool the

@@ -133,6 +133,12 @@ class Config:
     # is `serve` with the coordinator forced on
     fleet: bool = False
     fleet_members: Optional[str] = None
+    # AOT program assets (fishnet_tpu/aot/): `pack` builds a bundle,
+    # `warm` installs one. aot_bundle = pack output / warm source;
+    # aot_dir = warm's install target. Engines read the store root from
+    # FISHNET_TPU_AOT_DIR only — these flags never touch the environment
+    aot_bundle: Optional[str] = None
+    aot_dir: Optional[str] = None
     conf: Optional[str] = None
     no_conf: bool = False
     verbose: int = 0
@@ -153,7 +159,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("command", nargs="?", default="run",
                    choices=["run", "configure", "systemd", "systemd-user",
-                            "license", "bench", "serve", "fleet"])
+                            "license", "bench", "serve", "fleet",
+                            "pack", "warm"])
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--auto-update", action="store_true")
     p.add_argument("--conf", help="path to fishnet.ini")
@@ -205,6 +212,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(supervised host children here) or "
                         "'http://HOST:PORT' (remote serve endpoints); "
                         "default FISHNET_TPU_FLEET_MEMBERS")
+    p.add_argument("--aot-bundle",
+                   help="pack subcommand: output directory for the AOT "
+                        "program bundle (default: the live store); warm "
+                        "subcommand: bundle directory to install")
+    p.add_argument("--aot-dir",
+                   help="warm subcommand: store root to install the bundle "
+                        "into (default FISHNET_TPU_AOT_DIR, "
+                        "~/.cache/fishnet-tpu/aot); engines read the store "
+                        "root from FISHNET_TPU_AOT_DIR at boot")
     p.add_argument("--user-backlog", help="short, long, or duration")
     p.add_argument("--system-backlog", help="short, long, or duration")
     p.add_argument("--max-backoff", help="maximum backoff duration")
@@ -301,6 +317,8 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.fleet = bool(args.fleet) or args.command == "fleet" or \
         str(ini.get("fleet", "")).strip().lower() in ("1", "true", "yes", "on")
     cfg.fleet_members = pick(args.fleet_members, "fleet_members")
+    cfg.aot_bundle = pick(args.aot_bundle, "aot_bundle")
+    cfg.aot_dir = pick(args.aot_dir, "aot_dir")
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))
     cfg.system_backlog = parse_backlog(pick(args.system_backlog, "system_backlog"))
     cfg.max_backoff = parse_duration(str(pick(args.max_backoff, "max_backoff", "30s")))

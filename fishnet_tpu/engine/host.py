@@ -64,13 +64,22 @@ def _build_engine(args, log):
                      else bool(args.mesh_refill)),
     )
     if not args.skip_warmup:
+        from ..aot import registry as aot_registry
+
         engine.warmup(None, log)
-        # variant programs compile in the background, same as the old
-        # in-process wiring (client/app.py round 5) — chunks interleave
-        # behind the engine lock while the remaining shapes warm
-        threading.Thread(
-            target=lambda: engine.warmup_variants(log), daemon=True
-        ).start()
+        if aot_registry.warm_covers("variants"):
+            # every variant program is preloaded from the AOT bundle —
+            # spinning the compile thread anyway would silently paper
+            # over bundle misses (the aot smoke asserts it stays quiet)
+            log("warmup: variant programs preloaded from AOT bundle; "
+                "background compile thread skipped")
+        else:
+            # variant programs compile in the background, same as the old
+            # in-process wiring (client/app.py round 5) — chunks interleave
+            # behind the engine lock while the remaining shapes warm
+            threading.Thread(
+                target=lambda: engine.warmup_variants(log), daemon=True
+            ).start()
     return engine
 
 
@@ -156,7 +165,14 @@ def main(argv=None) -> int:
     except Exception as e:
         log(f"engine construction/warmup failed: {type(e).__name__}: {e}")
         return 1
-    send({"t": "ready", "mono": time.monotonic()})
+    # the ready frame carries the AOT boot report so the supervisor can
+    # log (and the fleet surface) whether this replica booted warm
+    from ..aot import registry as aot_registry
+
+    send({
+        "t": "ready", "mono": time.monotonic(),
+        "aot": aot_registry.boot_report(),
+    })
     phases.enter("idle")
 
     # stream each finished position the moment the engine's exactly-once

@@ -226,6 +226,9 @@ class SupervisedEngine(ChunkSubmit):
         self._pending = None  # (go id, future) for the in-flight chunk
         self._last_frame = 0.0
         self._phase: dict = {}
+        # last ready frame's AOT boot report (engine/host.py): did this
+        # child boot warm from a program bundle, and what does it cover
+        self.aot_report: Optional[dict] = None
         self._down_noted = True  # no live child yet
         self._closing = False
         self._go_id = 0
@@ -768,6 +771,19 @@ class SupervisedEngine(ChunkSubmit):
                     if isinstance(mono, (int, float)):
                         # config-time estimate: first usable offset
                         self._clock.sample(float(mono), self._last_frame)
+                    rep = msg.get("aot")
+                    if isinstance(rep, dict):
+                        # surfaced into fleet member health and logs: a
+                        # replica that booted warm (AOT bundle) vs cold
+                        self.aot_report = rep
+                        if rep.get("enabled"):
+                            self.logger.info(
+                                f"engine host: AOT assets active — "
+                                f"{rep.get('programs', 0)} programs "
+                                f"(bundle {rep.get('fingerprint', '?')}, "
+                                f"covers "
+                                f"{','.join(rep.get('covers') or []) or 'none'})"
+                            )
                     if not ready_fut.done():
                         ready_fut.set_result(True)
                 elif t == "trace":

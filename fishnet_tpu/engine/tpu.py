@@ -196,6 +196,13 @@ class TpuEngine(ChunkSubmit):
                     jax.random.PRNGKey(seed), l1=64, feature_set="board768"
                 )
         self._logger = logger
+        # AOT program assets (fishnet_tpu/aot/): when a packed bundle
+        # matches this process's fingerprint, the wrapped search jits
+        # load serialized executables instead of compiling, and warmup
+        # below becomes a no-op. Install is idempotent process-wide.
+        from ..aot import registry as aot_registry
+
+        self.aot = aot_registry.install_from_settings(logger=self._warn)
         # FISHNET_TPU_DTYPE quantizes the weights (SURVEY §7.2):
         # bf16 → MXU-native float inputs, f32 accumulators. The int8
         # fixed-point ladder (nnue.quantize_int8) measured a NET LOSS at
@@ -307,7 +314,7 @@ class TpuEngine(ChunkSubmit):
         else:
             print(f"W: {msg}", file=sys.stderr, flush=True)
 
-    def warmup(self, buckets=None, log=None, deep=None) -> None:
+    def warmup(self, buckets=None, log=None, deep=None) -> List[str]:
         """Pre-compile the hot search program for every production lane
         bucket.
 
@@ -336,6 +343,23 @@ class TpuEngine(ChunkSubmit):
                 or LANE_BUCKETS
             )
             trimmed = settings.is_set("FISHNET_TPU_WARMUP_BUCKETS")
+        want_deep = deep if deep is not None else not trimmed
+        covered = ["buckets"] + (["deep"] if want_deep else [])
+        # AOT bundle covering exactly what this warmup would compile:
+        # skip it — the wrapped jits load serialized executables at
+        # first dispatch in milliseconds instead of compiling here.
+        from ..aot import registry as aot_registry
+
+        if aot_registry.warm_covers(*covered):
+            rep = aot_registry.boot_report()
+            if log is not None:
+                log(
+                    f"warmup: skipped — AOT bundle {rep.get('fingerprint')} "
+                    f"preloads {rep.get('programs')} programs (covers "
+                    f"{','.join(rep.get('covers') or [])}); executables "
+                    f"load at first dispatch"
+                )
+            return covered
         for b in buckets:
             b = self._pad(b)
             t0 = _time.monotonic()
@@ -360,8 +384,8 @@ class TpuEngine(ChunkSubmit):
         # bucket set was trimmed (env var or explicit caller buckets —
         # usually a CPU smoke run/test that serves no move jobs and
         # where each extra compile costs minutes).
-        if not (deep if deep is not None else not trimmed):
-            return
+        if not want_deep:
+            return covered
         b = self._pad(64)  # root-move lanes pad to 64 for ≤64 legal moves
         t0 = _time.monotonic()
         roots = stack_boards([from_position(Position.initial())] * b)
@@ -374,8 +398,9 @@ class TpuEngine(ChunkSubmit):
                 f"warmup: {b}-lane move-job program compiled "
                 f"({_time.monotonic() - t0:.1f}s)"
             )
+        return covered
 
-    def warmup_variants(self, log=None) -> None:
+    def warmup_variants(self, log=None) -> List[str]:
         """Compile the per-variant search programs (each variant is a
         distinct statically compiled program — a cold compile at the
         first variant chunk would race its deadline; move jobs' 7 s
@@ -396,14 +421,23 @@ class TpuEngine(ChunkSubmit):
         env = settings.get_str("FISHNET_TPU_WARMUP_VARIANTS") or "auto"
         if env.lower() == "auto":
             if jax.default_backend() == "cpu":
-                return
+                return []
             variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
         elif env.lower() in ("", "none"):
-            return
+            return []
         elif env.lower() == "all":
             variants = sorted(set(DEVICE_VARIANTS.values()) - {"standard"})
         else:
             variants = [v for v in env.split(",") if v]
+        from ..aot import registry as aot_registry
+
+        if aot_registry.warm_covers("variants"):
+            if log is not None:
+                log(
+                    "warmup: variant programs covered by the AOT bundle; "
+                    "background compiles skipped"
+                )
+            return []
         for variant in variants:
             # 16 lanes / exact-depth probes: analysis chunks.
             # _move_job_floor lanes / deep-bounds probes: move-job
@@ -444,6 +478,7 @@ class TpuEngine(ChunkSubmit):
                         f"warmup: {variant} {b}-lane program compiled "
                         f"({_time.monotonic() - t0:.1f}s)"
                     )
+        return variants
 
     async def go_multiple(self, chunk: Chunk) -> List[PositionResponse]:
         loop = asyncio.get_running_loop()

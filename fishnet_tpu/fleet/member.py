@@ -75,6 +75,12 @@ class FleetMember:
         if now is None:
             now = time.monotonic()
         hb = getattr(self.engine, "heartbeat_age", None)
+        # local members relay their host child's AOT boot report
+        # (engine/host.py ready frame → supervisor.aot_report): an
+        # autoscaler reading fleet health can tell warm boots (bundle
+        # preloaded, seconds to first dispatch) from cold ones (minutes
+        # of XLA compiles) and scale accordingly
+        aot = getattr(self.engine, "aot_report", None)
         return {
             "name": self.name,
             "kind": self.kind,
@@ -85,6 +91,7 @@ class FleetMember:
             "draining": self.draining,
             "cooldown_s": max(self.down_until - now, 0.0),
             "heartbeat_age_s": hb,
+            "aot": aot,
         }
 
 
@@ -112,6 +119,13 @@ def make_local_member(
     coordinator re-dispatches on (module docstring has the why). The
     member's partial journal still streams (replay=True) and every
     accepted ack is mirrored into `member.acked` via `on_partial`.
+
+    AOT program assets need no member-level wiring: the FISHNET_TPU_AOT*
+    settings are engine-affecting, so the supervisor's engine_env
+    overlay forwards them into the host child, the child's TpuEngine
+    preloads the bundle, and its ready-frame boot report surfaces here
+    as `health()["aot"]` — a scale-out member on a warmed machine boots
+    in seconds instead of recompiling every program.
     """
     from ..engine.supervisor import SupervisedEngine
 

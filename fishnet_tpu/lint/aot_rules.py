@@ -1,0 +1,103 @@
+"""AOT asset-store discipline rules.
+
+Serialized executables are only loadable under the exact store
+fingerprint + program key they were exported with (aot/keys.py); a
+serialize/deserialize call made anywhere else produces artifacts with
+no compat envelope — they load under skewed jax versions, stale knob
+values, or the wrong device kind and fail (or worse, silently answer)
+at runtime. All export/import of compiled programs must route through
+the registry (fishnet_tpu/aot/registry.py), which keys every artifact.
+
+Rules:
+  aot-unkeyed-export   any call that resolves to
+                       jax.experimental.serialize_executable.serialize /
+                       deserialize_and_load, or jax.export.* — in any
+                       package/tool file other than
+                       fishnet_tpu/aot/registry.py.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project, SourceFile, dotted, register_family
+
+# the one file allowed to touch the serialization APIs directly
+_ALLOWED = "fishnet_tpu/aot/registry.py"
+
+_SER_MODULE = "jax.experimental.serialize_executable"
+_SER_FUNCS = {"serialize", "deserialize_and_load"}
+
+
+def _export_call_sites(src: SourceFile) -> List[ast.Call]:
+    """Every call in this file that resolves to an executable
+    serialization API: serialize/deserialize_and_load through any
+    import form of jax.experimental.serialize_executable, and anything
+    under jax.export (an alias of it included)."""
+    ser_mod_aliases: Set[str] = set()   # alias -> serialize_executable mod
+    export_mod_aliases: Set[str] = set()  # alias -> jax.export mod
+    bare_names: Set[str] = set()        # from-imported serialize funcs
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _SER_MODULE:
+                    ser_mod_aliases.add(alias.asname or alias.name)
+                elif alias.name == "jax.export":
+                    export_mod_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                continue
+            if node.module == _SER_MODULE:
+                for alias in node.names:
+                    if alias.name in _SER_FUNCS:
+                        bare_names.add(alias.asname or alias.name)
+            elif node.module == "jax.experimental":
+                for alias in node.names:
+                    if alias.name == "serialize_executable":
+                        ser_mod_aliases.add(alias.asname or alias.name)
+            elif node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "export":
+                        export_mod_aliases.add(alias.asname or alias.name)
+            elif node.module == "jax.export":
+                for alias in node.names:
+                    bare_names.add(alias.asname or alias.name)
+
+    sites: List[ast.Call] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        head, _, tail = name.rpartition(".")
+        if name in bare_names:
+            sites.append(node)
+        elif head in ser_mod_aliases and tail in _SER_FUNCS:
+            sites.append(node)
+        elif any(head == m or head.startswith(m + ".")
+                 for m in export_mod_aliases):
+            sites.append(node)
+        elif name.startswith("jax.export."):
+            sites.append(node)
+    return sites
+
+
+@register_family("aot")
+def check_aot_keyed_export(project: Project) -> List[Finding]:
+    """Executable serialization stays behind the fingerprint key."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu", "tools", "bench.py"):
+        if src.rel == _ALLOWED:
+            continue
+        for node in _export_call_sites(src):
+            findings.append(src.finding(
+                "aot-unkeyed-export", node,
+                "executable serialization outside aot/registry.py "
+                "produces artifacts with no store fingerprint or program "
+                "key — they outlive jax upgrades and knob flips and fail "
+                "(or mis-answer) at deserialize; route through "
+                "fishnet_tpu/aot/registry.py, which keys every artifact "
+                "via aot/keys.py",
+            ))
+    return findings

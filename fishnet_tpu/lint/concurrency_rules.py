@@ -34,11 +34,12 @@ that true:
 
 Scopes: the timeout/lock rules run on the process-boundary modules
 (supervisor, host, uci, workers, queue), on fishnet_tpu/serve/ (the
-HTTP front-end is a process boundary too), and on fishnet_tpu/fleet/
-(the coordinator fans out across N member processes/machines); the
-except rules run on all of client/, engine/, serve/ and fleet/
-(kernels and utils keep their own idioms — e.g. compile_cache
-deliberately degrades to "no cache" on any error).
+HTTP front-end is a process boundary too), on fishnet_tpu/fleet/
+(the coordinator fans out across N member processes/machines), and on
+fishnet_tpu/aot/ (registry export threads and flush() joins sit on the
+engine boot path); the except rules run on all of client/, engine/,
+serve/, fleet/ and aot/ (kernels and utils keep their own idioms —
+e.g. compile_cache deliberately degrades to "no cache" on any error).
 The sock-in-loop rule runs on serve/ and fleet/ — the packages whose
 code lives inside a single shared event loop.
 Narrow handlers (`except OSError: pass` around best-effort logging) are
@@ -69,7 +70,9 @@ from .core import (
     register_family,
 )
 
-# modules where an unbounded block is a liveness bug
+# modules where an unbounded block is a liveness bug. fishnet_tpu/aot
+# is in scope: the registry's export threads and flush() joins sit on
+# the engine boot path, and an unbounded wait there wedges warmup
 BLOCK_SCOPE = (
     "fishnet_tpu/engine/supervisor.py",
     "fishnet_tpu/engine/host.py",
@@ -78,11 +81,13 @@ BLOCK_SCOPE = (
     "fishnet_tpu/client/queue.py",
     "fishnet_tpu/serve",
     "fishnet_tpu/fleet",
+    "fishnet_tpu/aot",
 )
 
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine",
-                "fishnet_tpu/serve", "fishnet_tpu/fleet")
+                "fishnet_tpu/serve", "fishnet_tpu/fleet",
+                "fishnet_tpu/aot")
 
 # these packages run inside ONE shared event loop: a blocking socket
 # call in an async def stalls every tenant (serve) or every member

@@ -54,6 +54,7 @@ warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable"
 )
 
+from ..aot import registry as _aot_registry
 from ..models import nnue
 from ..utils import settings
 from .board import (
@@ -1003,16 +1004,26 @@ def _run_segment(params: nnue.NnueParams, state: SearchState,
 # chained segments alias the multi-MB tables in place instead of
 # copying them, so a caller must treat the arguments it passed as
 # consumed and continue from the returned state/ttab only.
-_run_segment_jit = jax.jit(
+_run_segment_jit = _aot_registry.wrap(
+    "run_segment",
+    jax.jit(
+        _run_segment,
+        static_argnames=("variant", "deep_tt", "prefer_deep"),
+        donate_argnums=(1, 2),
+    ),
     _run_segment,
-    static_argnames=("variant", "deep_tt", "prefer_deep"),
-    donate_argnums=(1, 2),
+    static_names=("variant", "deep_tt", "prefer_deep"),
 )
 # the big tables are OUTPUTS of init_state; its only device-state-shaped
 # inputs are the history rows, donated so refill splices don't copy them
-_init_state_jit = jax.jit(
-    init_state, static_argnames=("max_ply", "variant"),
-    donate_argnames=("hist_hash", "hist_halfmove"),
+_init_state_jit = _aot_registry.wrap(
+    "init_state",
+    jax.jit(
+        init_state, static_argnames=("max_ply", "variant"),
+        donate_argnames=("hist_hash", "hist_halfmove"),
+    ),
+    init_state,
+    static_names=("max_ply", "variant"),
 )
 
 
@@ -1061,7 +1072,11 @@ def _merge_lanes(state: SearchState, fresh: SearchState,
 # both inputs are donated: the running state's tables are overwritten in
 # place where the mask selects, and the fresh (refill-sized) state is
 # consumed by the splice — a refill boundary allocates nothing big
-_merge_lanes_jit = jax.jit(_merge_lanes, donate_argnums=(0, 1))
+_merge_lanes_jit = _aot_registry.wrap(
+    "merge_lanes",
+    jax.jit(_merge_lanes, donate_argnums=(0, 1)),
+    _merge_lanes,
+)
 
 
 def _refill_fresh(params: nnue.NnueParams, state: SearchState,

@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..aot import registry as _aot_registry
+
 try:
     _shard_map = jax.shard_map
     _SHARD_MAP_KW = {"check_vma": False}
@@ -91,7 +93,16 @@ def _segment_callable(mesh: Mesh, axis: str, has_tt: bool,
                    P(axis, None, None)),
         **_SHARD_MAP_KW,
     )
-    return jax.jit(fn, donate_argnums=(1, 2))
+    # AOT-wrapped (fishnet_tpu/aot/): the shard_map closure's compile
+    # flags become extra key material — all call arguments are dynamic
+    return _aot_registry.wrap(
+        "mesh_segment", jax.jit(fn, donate_argnums=(1, 2)), seg,
+        extra_static={
+            "mesh": "x".join(str(d) for d in mesh.devices.shape),
+            "axis": axis, "has_tt": has_tt, "variant": variant,
+            "deep_tt": deep_tt, "prefer_deep": prefer_deep,
+        },
+    )
 
 
 def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
@@ -139,7 +150,13 @@ def _merge_callable(mesh: Mesh, axis: str):
         out_specs=P(axis),
         **_SHARD_MAP_KW,
     )
-    return jax.jit(fn, donate_argnums=(0, 1))
+    return _aot_registry.wrap(
+        "mesh_merge", jax.jit(fn, donate_argnums=(0, 1)), _merge_lanes,
+        extra_static={
+            "mesh": "x".join(str(d) for d in mesh.devices.shape),
+            "axis": axis,
+        },
+    )
 
 
 def refill_lanes_sharded(mesh: Mesh, params, state, new_roots, lane_idx,

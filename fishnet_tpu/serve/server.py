@@ -343,6 +343,18 @@ async def run_serve(cfg) -> int:
         else:
             await asyncio.to_thread(engine.warmup, None, logger.info)
             logger.info("serve: TPU engine ready.")
+        # autoscaling cold-start signal (docs/aot.md): a replica booted
+        # from an AOT bundle reached this point without compiling, so
+        # it can accept traffic the moment the listener opens
+        from ..aot import registry as aot_registry
+
+        rep = getattr(engine, "aot_report", None) or aot_registry.boot_report()
+        if rep.get("enabled"):
+            logger.info(
+                f"serve: AOT assets — {rep.get('programs', 0)} programs "
+                f"(bundle {rep.get('fingerprint', '?')}, covers "
+                f"{','.join(rep.get('covers') or []) or 'none'})"
+            )
 
     session = EngineSession(engine, flavor=flavor)
     app = ServeApp(session, logger=logger)

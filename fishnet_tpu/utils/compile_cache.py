@@ -1,10 +1,18 @@
-"""Persistent XLA compilation cache.
+"""Persistent XLA compilation cache — tier 2 of the warm-boot ladder.
 
 The reference spends build time on PGO so shipped engine binaries start
 fast (reference: build.rs:249-261). The TPU analog of that cost is XLA
 compilation: the search program takes 20-40 s to compile per lane-bucket
 shape. Persisting compiled executables to disk makes every restart after
 the first start warm — the same "pay once, run fast forever" trade.
+
+Since the AOT asset registry landed (fishnet_tpu/aot/, docs/aot.md)
+this cache is the SECOND tier, not the first: a packed bundle loads
+serialized executables with zero XLA involvement at all; this cache
+only softens the compiles that still happen — AOT misses, export runs
+(`pack` itself compiles through it), and programs the bundle doesn't
+cover. It stays on by default because the tiers compose: a miss that
+falls back to JIT hits this cache before it hits the compiler.
 
 Disabled with FISHNET_TPU_NO_COMPILE_CACHE=1 (e.g. read-only filesystems).
 """
@@ -16,14 +24,51 @@ from typing import Optional
 from . import settings
 
 _enabled_path: Optional[Path] = None
+_force_disabled = False
+
+
+def _drop_cache_memo() -> None:
+    # jax memoizes "is the persistent cache used" at the first compile
+    # (compilation_cache._cache_checked), so flipping the config dir
+    # mid-process is silently ignored unless that memo is reset too
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # private API moved: config-only toggling still covers
+        # processes that flip the cache before their first compile
+
+
+def disable_compile_cache() -> None:
+    """Turn the persistent cache off for the rest of this process.
+
+    AOT export (``pack``) requires it: serializing an executable that was
+    a persistent-cache HIT yields an incomplete payload that fails at
+    deserialize time with "Symbols not found" — exported programs must be
+    compiled for real. Later enable_compile_cache() calls become no-ops."""
+    global _enabled_path, _force_disabled
+    _force_disabled = True
+    _enabled_path = None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass  # jax absent/old: nothing was cached anyway
+    _drop_cache_memo()
 
 
 def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
     """Point JAX's persistent compilation cache at a writable directory.
 
     Idempotent; returns the cache dir, or None when disabled/unavailable.
-    Must be called before the first compilation to benefit it."""
+    Must be called before the first compilation to benefit it. `path` is
+    a ROOT: a /<backend> namespace dir is appended to it, so never pass
+    a previously returned cache dir back in."""
     global _enabled_path
+    if _force_disabled:
+        return None
     if settings.get_bool("FISHNET_TPU_NO_COMPILE_CACHE"):
         return None
     if _enabled_path is not None:
@@ -48,6 +93,7 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[Path]:
         # the small host-callback programs add up across restarts
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _drop_cache_memo()
         _enabled_path = p
         return p
     except Exception:

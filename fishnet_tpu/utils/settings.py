@@ -350,6 +350,34 @@ SETTINGS: Tuple[Setting, ...] = (
             "still applies underneath).",
     ),
     Setting(
+        name="FISHNET_TPU_AOT",
+        kind="bool",
+        default="1",
+        doc="AOT program assets (fishnet_tpu/aot/): preload serialized "
+            "compiled search programs from the registry instead of "
+            "JIT-compiling at warmup; misses fall back to JIT with a "
+            "warning. 0 disables the registry entirely.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_AOT_DIR",
+        kind="str",
+        default="",
+        doc="AOT program store root "
+            "(default ~/.cache/fishnet-tpu/aot). `python -m fishnet_tpu "
+            "pack` writes here, engines read at boot.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_AOT_EXPORT",
+        kind="bool",
+        default="0",
+        doc="Background re-export: on an AOT miss, serialize the "
+            "JIT-compiled executable back into the store so the next "
+            "boot hits (pack sets this implicitly).",
+        engine=True,
+    ),
+    Setting(
         name="FISHNET_TPU_COMPILE_CACHE",
         kind="str",
         default="",

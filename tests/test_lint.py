@@ -698,6 +698,71 @@ def test_mutated_heartbeat_is_caught(tmp_path):
     assert by_rule(result.findings, "obs-wall-clock")
 
 
+# --------------------------------------------------------------------- aot
+
+
+AOT_BAD = '''
+import jax
+import jax.export
+from jax.experimental import serialize_executable
+from jax.experimental.serialize_executable import serialize, deserialize_and_load
+
+
+def snapshot(compiled):
+    blob = serialize(compiled)                        # aot-unkeyed-export
+    blob2 = serialize_executable.serialize(compiled)  # aot-unkeyed-export
+    exp = jax.export.export(jax.jit(sum))             # aot-unkeyed-export
+    fn = deserialize_and_load(*blob)                  # aot-unkeyed-export
+    return blob2, exp, fn
+'''
+
+
+def test_unkeyed_export_flagged_through_every_import_form(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/snapshots.py": AOT_BAD}
+    )
+    result = run_lint(project, only_families={"aot"})
+    found = by_rule(result.findings, "aot-unkeyed-export")
+    assert len(found) == 4
+    assert all("registry" in f.message for f in found)
+
+
+def test_registry_module_is_sanctioned(tmp_path):
+    # the identical calls inside the one keyed-store module are the point
+    project = make_project(
+        tmp_path, {"fishnet_tpu/aot/registry.py": AOT_BAD}
+    )
+    result = run_lint(project, only_families={"aot"})
+    assert by_rule(result.findings, "aot-unkeyed-export") == []
+
+
+def test_unkeyed_export_scope_covers_tools_not_tests(tmp_path):
+    project = make_project(tmp_path, {
+        "tools/export_hack.py": AOT_BAD,
+        "tests/test_roundtrip.py": AOT_BAD,
+    })
+    result = run_lint(project, only_families={"aot"})
+    found = by_rule(result.findings, "aot-unkeyed-export")
+    assert {f.path for f in found} == {"tools/export_hack.py"}
+
+
+def test_relocated_registry_code_is_caught(tmp_path):
+    """Mutation test: lift the real registry's serialize path into
+    another module (the exact drift the rule exists for) and assert the
+    lint flags it there while the in-place copy stays clean."""
+    real = (REPO_ROOT / "fishnet_tpu/aot/registry.py").read_text()
+    assert "_serialize_executable.serialize(" in real
+    project = make_project(tmp_path, {
+        "fishnet_tpu/aot/registry.py": real,
+        "fishnet_tpu/engine/warmstore.py": real,
+    })
+    result = run_lint(project, only_families={"aot"})
+    found = by_rule(result.findings, "aot-unkeyed-export")
+    assert found and all(
+        f.path == "fishnet_tpu/engine/warmstore.py" for f in found
+    )
+
+
 # ------------------------------------------- suppressions, baseline, CLI
 
 

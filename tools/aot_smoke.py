@@ -1,0 +1,275 @@
+"""Acceptance smoke for AOT program assets (fishnet_tpu/aot/).
+
+Proves the warmup-free-boot contract end to end, in real subprocesses
+(the whole point is surviving a process boundary — an in-process
+round-trip would share jit caches and prove nothing):
+
+1. **pack** — `python -m fishnet_tpu pack --aot-bundle <store>` in a
+   fresh process compiles every hot search program and serializes the
+   bundle.
+2. **reference child** — FISHNET_TPU_AOT=0: plain JIT boot + a 16-lane
+   depth-1 search of the initial position; records scores/nodes.
+3. **warm child** — FISHNET_TPU_AOT=1 + FISHNET_TPU_AOT_DIR=<store> +
+   FISHNET_TPU_TRACE_DIR: the same boot and search against the bundle,
+   then dumps its trace timeline.
+
+Gate (any failure exits 1):
+
+* warm child's registry stats: 0 misses, 0 errors, >= 1 disk load;
+* warm child's trace: >= 1 ``aot.load`` instant, zero ``aot.miss``
+  instants, and zero ``xla_backend_compile`` spans at or above the
+  program threshold (0.5 s — eager host-callback compiles are
+  milliseconds, a search-program compile is tens of seconds);
+* scores and node counts bit-identical between the two children.
+
+Both children and the pack run share one tiny CPU config
+(MAX_PLY=8, WARMUP_BUCKETS=16, HELPERS=1) and disable the persistent
+XLA cache so neither side can warm-start around the thing under test.
+
+    JAX_PLATFORMS=cpu python tools/aot_smoke.py
+    JAX_PLATFORMS=cpu python tools/aot_smoke.py --format=github
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+SMOKE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "FISHNET_TPU_MAX_PLY": "8",
+    "FISHNET_TPU_WARMUP_BUCKETS": "16",
+    "FISHNET_TPU_HELPERS": "1",
+    "FISHNET_TPU_NO_COMPILE_CACHE": "1",
+}
+PACK_TIMEOUT_S = 540.0
+CHILD_TIMEOUT_S = 420.0
+# a real search-program compile is tens of seconds even on the CPU
+# backend at these knobs; eager host-callback compiles are ~10 ms
+BIG_COMPILE_US = 0.5e6
+LANES = 16
+
+
+class SmokeFailure(Exception):
+    pass
+
+
+# --------------------------------------------------------------- child
+
+
+def run_child(out_path: str, trace_path: str) -> int:
+    """--child mode: boot an engine under the env the parent prepared,
+    search, and write a JSON report (plus a trace dump when tracing)."""
+    import numpy as np
+
+    from fishnet_tpu.obs import trace
+
+    trace.install_from_settings("aot-smoke")  # no-op without TRACE_DIR
+
+    t0 = time.monotonic()
+    from fishnet_tpu.aot import registry
+    from fishnet_tpu.chess.position import Position
+    from fishnet_tpu.engine.tpu import TpuEngine
+    from fishnet_tpu.ops.board import from_position, stack_boards
+
+    eng = TpuEngine()
+    eng.warmup(None, lambda m: print(f"  [child] {m}", flush=True))
+    roots = stack_boards([from_position(Position.initial())] * LANES)
+    out = eng._search(
+        roots,
+        np.ones(LANES, np.int32),
+        np.full(LANES, 64, np.int32),
+    )
+    scores = np.asarray(out["score"]).astype(int).tolist()
+    nodes = int(np.asarray(out["nodes"]).sum())
+
+    reg = registry.REGISTRY
+    rec = trace.RECORDER
+    if trace_path and rec is not None:
+        rec.dump(trace_path)
+    report = {
+        "scores": scores,
+        "nodes": nodes,
+        "stats": dict(reg.stats) if reg is not None else {},
+        "aot": registry.boot_report(),
+        "compiles": registry.compile_count(),
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh)
+    print(f"  [child] done in {report['wall_s']}s: "
+          f"nodes={nodes} aot={report['aot']}", flush=True)
+    return 0
+
+
+# -------------------------------------------------------------- parent
+
+
+def _run(tag: str, argv: list, env: dict, timeout_s: float) -> None:
+    print(f"aot-smoke: {tag}: {' '.join(argv[2:] or argv)}", flush=True)
+    proc = subprocess.run(
+        argv, cwd=str(REPO_ROOT), env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout_s,
+    )
+    for line in (proc.stdout or "").splitlines():
+        print(f"  [{tag}] {line}")
+    if proc.returncode != 0:
+        raise SmokeFailure(f"{tag} exited {proc.returncode}")
+
+
+def _load_json(path: Path, what: str) -> dict:
+    try:
+        return json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        raise SmokeFailure(f"{what} unreadable: {e}") from None
+
+
+def _check_trace(trace_path: Path) -> None:
+    doc = _load_json(trace_path, "warm child trace")
+    events = doc.get("traceEvents", [])
+    names = [e.get("name", "") for e in events]
+    misses = names.count("aot.miss")
+    loads = names.count("aot.load")
+    big = [
+        e for e in events
+        if e.get("name") == "xla_backend_compile"
+        and float(e.get("dur", 0.0)) >= BIG_COMPILE_US
+    ]
+    if misses:
+        raise SmokeFailure(f"warm trace has {misses} aot.miss instant(s)")
+    if not loads:
+        raise SmokeFailure("warm trace has no aot.load instant")
+    if big:
+        worst = max(float(e.get("dur", 0.0)) for e in big) / 1e6
+        raise SmokeFailure(
+            f"warm trace has {len(big)} compile span(s) >= "
+            f"{BIG_COMPILE_US / 1e6:.1f}s (worst {worst:.1f}s) — "
+            "the bundle did not preempt compilation"
+        )
+    print(f"aot-smoke: trace ok — {loads} load(s), 0 misses, "
+          f"0 program-scale compile spans ({len(events)} events)")
+
+
+def run_smoke(keep: bool) -> None:
+    tmp = Path(tempfile.mkdtemp(prefix="aot-smoke-"))
+    store = tmp / "store"
+    base = {**os.environ, **SMOKE_ENV}
+    base.pop("FISHNET_TPU_TRACE_DIR", None)
+    me = str(Path(__file__).resolve())
+    try:
+        # ---- 1. pack a bundle through the real CLI -------------------
+        _run(
+            "pack",
+            [sys.executable, "-m", "fishnet_tpu", "pack",
+             "--aot-bundle", str(store), "--no-conf"],
+            {**base, "FISHNET_TPU_AOT": "0"},
+            PACK_TIMEOUT_S,
+        )
+        manifests = list(store.glob("*/manifest.json"))
+        if len(manifests) != 1:
+            raise SmokeFailure(
+                f"pack left {len(manifests)} manifest(s) under {store}"
+            )
+        man = _load_json(manifests[0], "bundle manifest")
+        n_prog = len(man.get("programs", {}))
+        if not n_prog:
+            raise SmokeFailure("pack produced an empty bundle")
+        print(f"aot-smoke: packed {n_prog} program(s), "
+              f"covers={man.get('covers')}")
+
+        # ---- 2. plain-JIT reference --------------------------------
+        ref_json = tmp / "ref.json"
+        _run(
+            "jit-ref",
+            [sys.executable, me, "--child", str(ref_json)],
+            {**base, "FISHNET_TPU_AOT": "0"},
+            CHILD_TIMEOUT_S,
+        )
+        ref = _load_json(ref_json, "reference report")
+        if ref["nodes"] <= 0:
+            raise SmokeFailure("reference search visited no nodes")
+        if ref["aot"].get("enabled"):
+            raise SmokeFailure("reference child had AOT enabled")
+
+        # ---- 3. warm boot against the bundle ------------------------
+        warm_json = tmp / "warm.json"
+        warm_trace = tmp / "warm-trace.json"
+        _run(
+            "warm",
+            [sys.executable, me, "--child", str(warm_json),
+             "--trace", str(warm_trace)],
+            {**base,
+             "FISHNET_TPU_AOT": "1",
+             "FISHNET_TPU_AOT_DIR": str(store),
+             "FISHNET_TPU_TRACE_DIR": str(tmp)},
+            CHILD_TIMEOUT_S,
+        )
+        warm = _load_json(warm_json, "warm report")
+        stats = warm.get("stats", {})
+        if not warm["aot"].get("enabled"):
+            raise SmokeFailure(
+                f"warm child never activated the bundle: {warm['aot']}"
+            )
+        if stats.get("misses", 1) != 0 or stats.get("errors", 1) != 0:
+            raise SmokeFailure(f"warm child registry stats: {stats}")
+        if stats.get("loads", 0) < 1:
+            raise SmokeFailure(f"warm child loaded nothing: {stats}")
+        _check_trace(warm_trace)
+
+        # ---- 4. bit-identity ----------------------------------------
+        if warm["scores"] != ref["scores"] or warm["nodes"] != ref["nodes"]:
+            raise SmokeFailure(
+                "warm result diverged from JIT reference: "
+                f"scores {warm['scores']} vs {ref['scores']}, "
+                f"nodes {warm['nodes']} vs {ref['nodes']}"
+            )
+        print(f"aot-smoke: bit-identical — scores {ref['scores'][:4]}..., "
+              f"nodes {ref['nodes']}; warm boot {warm['wall_s']}s vs "
+              f"JIT {ref['wall_s']}s")
+    finally:
+        if not keep:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            print(f"aot-smoke: artifacts kept at {tmp}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--child", metavar="OUT_JSON",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--trace", metavar="TRACE_JSON", default="",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the tempdir (bundle, reports, trace)")
+    parser.add_argument("--format", choices=["text", "github"],
+                        default="text")
+    args = parser.parse_args(argv)
+
+    if args.child:
+        return run_child(args.child, args.trace)
+
+    try:
+        run_smoke(args.keep)
+    except (SmokeFailure, subprocess.TimeoutExpired) as e:
+        if args.format == "github":
+            print(f"::error title=aot smoke::{e}")
+        print(f"aot-smoke: FAIL: {e}")
+        return 1
+    print("aot-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
