@@ -730,6 +730,201 @@ def run_serve_stage(timeout: float) -> dict | None:
             proc.wait(timeout=10.0)
 
 
+def run_serve_slo_stage(timeout: float) -> dict | None:
+    """SLO accounting row (round 14): closed-loop MIXED tenants against
+    a live serve subprocess — an interactive tenant firing 1-position
+    /bestmove requests under a tight deadline interleaved with a batch
+    tenant firing 4-position /analyse requests under a loose one.
+    Reports client-side p50/p99 per kind plus the server's own SLO
+    accounting (obs/metrics.py SloRecorder) scraped from /metrics:
+    deadline-miss rate and the queue-wait share of total latency —
+    the two numbers the admission controller is supposed to keep low
+    for interactive traffic even with batch load present."""
+    import http.client
+    import signal
+    import socket
+    import threading
+
+    backend = os.environ.get("BENCH_SERVE_BACKEND", "python")
+    clients = int(os.environ.get("BENCH_SLO_CLIENTS", "6"))
+    per_client = int(os.environ.get("BENCH_SLO_REQUESTS", "10"))
+    start_fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    t0 = time.monotonic()
+
+    # reserve a loopback port for the metrics endpoint — the settings
+    # switch only accepts a concrete positive port, so bind-and-release
+    # an ephemeral one (the tiny reuse race is acceptable for a bench)
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    metrics_port = sock.getsockname()[1]
+    sock.close()
+    env = dict(os.environ, FISHNET_TPU_METRICS_PORT=str(metrics_port))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fishnet_tpu", "serve",
+         "--backend", backend, "--serve-port", "0", "--no-conf"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+    )
+    try:
+        host_port = None
+        assert proc.stdout is not None
+        while time.monotonic() - t0 < min(timeout, 120.0):
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "serve: listening on " in line:
+                host_port = line.split("serve: listening on ", 1)[1].strip()
+                break
+        if host_port is None:
+            print("bench serve_slo: server never came up",
+                  file=sys.stderr, flush=True)
+            return None
+        host, _, port_s = host_port.rpartition(":")
+        port = int(port_s)
+        threading.Thread(
+            target=lambda: proc.stdout.read(), daemon=True
+        ).start()
+
+        lock = threading.Lock()
+        lat_ms: dict = {"analysis": [], "bestmove": []}
+        shed = [0]
+        failed = [0]
+
+        def one_client(cid: int) -> None:
+            interactive = cid % 2 == 0
+            conn = http.client.HTTPConnection(host, port, timeout=60.0)
+            try:
+                for i in range(per_client):
+                    if interactive:
+                        kind, path = "bestmove", "/bestmove"
+                        body = json.dumps({
+                            "id": f"slo-i{cid}-{i}",
+                            "tenant": "interactive",
+                            "priority": "interactive",
+                            "positions": [{"fen": start_fen, "moves": []}],
+                            "level": 1,
+                            # tight enough that queueing behind batch
+                            # work shows up as deadline misses
+                            "timeout_ms": 500,
+                        })
+                    else:
+                        kind, path = "analysis", "/analyse"
+                        body = json.dumps({
+                            "id": f"slo-b{cid}-{i}",
+                            "tenant": "batch",
+                            "priority": "batch",
+                            "positions": [
+                                {"fen": start_fen, "moves": []}
+                            ] * 4,
+                            "depth": 1,
+                            "timeout_ms": 30_000,
+                        })
+                    t1 = time.monotonic()
+                    try:
+                        conn.request("POST", path, body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                    except (OSError, ValueError, http.client.HTTPException):
+                        with lock:
+                            failed[0] += 1
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            host, port, timeout=60.0)
+                        continue
+                    dt_ms = (time.monotonic() - t1) * 1000.0
+                    with lock:
+                        if resp.status == 200:
+                            lat_ms[kind].append(dt_ms)
+                        elif resp.status == 429:
+                            shed[0] += 1
+                        else:
+                            failed[0] += 1
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=one_client, args=(cid,))
+                   for cid in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+
+        # scrape the server's SLO accounting BEFORE stopping it
+        slo: dict = {}
+        try:
+            mconn = http.client.HTTPConnection(
+                "127.0.0.1", metrics_port, timeout=10.0)
+            mconn.request("GET", "/metrics")
+            text = mconn.getresponse().read().decode("utf-8")
+            mconn.close()
+            for mline in text.splitlines():
+                if mline.startswith("#") or "{" in mline:
+                    continue  # skip comments and histogram buckets
+                name, _, value = mline.partition(" ")
+                if name.startswith("fishnet_slo_"):
+                    slo[name] = float(value)
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            print(f"bench serve_slo: metrics scrape failed: {e}",
+                  file=sys.stderr, flush=True)
+
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            print("bench serve_slo: server ignored SIGTERM",
+                  file=sys.stderr, flush=True)
+            return None
+        if not (lat_ms["analysis"] or lat_ms["bestmove"]):
+            print("bench serve_slo: no request completed",
+                  file=sys.stderr, flush=True)
+            return None
+
+        def pcts(vals: list) -> dict | None:
+            if not vals:
+                return None
+            vals = sorted(vals)
+            return {
+                "requests_ok": len(vals),
+                "p50_ms": round(vals[len(vals) // 2], 2),
+                "p99_ms": round(vals[min(len(vals) - 1,
+                                         (len(vals) * 99) // 100)], 2),
+            }
+
+        def slo_sum(what: str) -> float:
+            return sum(v for k, v in slo.items()
+                       if k.startswith(f"fishnet_slo_{what}_"))
+
+        requests = slo_sum("requests_total")
+        misses = slo_sum("deadline_miss_total")
+        latency_sum = sum(v for k, v in slo.items()
+                          if k.startswith("fishnet_slo_latency_ms_")
+                          and k.endswith("_sum"))
+        queue_sum = sum(v for k, v in slo.items()
+                        if k.startswith("fishnet_slo_queue_ms_")
+                        and k.endswith("_sum"))
+        return {
+            "backend": backend,
+            "clients": clients,
+            "interactive": pcts(lat_ms["bestmove"]),
+            "batch": pcts(lat_ms["analysis"]),
+            "shed": shed[0],
+            "failed": failed[0],
+            "deadline_miss_rate": (
+                round(misses / requests, 4) if requests else None
+            ),
+            "queue_wait_share": (
+                round(queue_sum / latency_sum, 4) if latency_sum else None
+            ),
+        }
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10.0)
+
+
 def run_fleet_stage(timeout: float) -> dict | None:
     """Fleet scaling row (ISSUE 12): the same position workload pushed
     through the fleet coordinator (fishnet_tpu/fleet/) over 1/2/4
@@ -1125,6 +1320,23 @@ def main() -> None:
             res = run_serve_stage(min(stage_timeout, remaining))
             matrix["serve_latency"] = res
             print("bench config serve_latency: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # SLO accounting row (round 14): mixed interactive/batch tenants in
+    # one closed loop; deadline-miss rate and queue-wait share come from
+    # the server's own SloRecorder via /metrics, p50/p99 per kind from
+    # the client side
+    if os.environ.get("BENCH_SERVE_SLO", "1") != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120.0:
+            print("bench: skipping serve_slo (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["serve_slo"] = None
+        else:
+            res = run_serve_slo_stage(min(stage_timeout, remaining))
+            matrix["serve_slo"] = res
+            print("bench config serve_slo: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
 

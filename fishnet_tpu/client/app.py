@@ -370,6 +370,58 @@ def _sync_check_key(endpoint: str, key: str) -> bool:
         return True  # network trouble: accept and let `run` find out
 
 
+def run_inflight(cfg: Config) -> int:
+    """`fishnet-tpu inflight`: one-shot view of what a running serve
+    process is doing RIGHT NOW — GET /debug/requests rendered as a
+    table (stage, lanes, age, deadline slack per in-flight request)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    host = cfg.serve_host or settings.get_str("FISHNET_TPU_SERVE_HOST")
+    port = (
+        cfg.serve_port if cfg.serve_port is not None
+        else settings.get_int("FISHNET_TPU_SERVE_PORT")
+    )
+    url = f"http://{host}:{port}/debug/requests"
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as r:
+            payload = json.loads(r.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"inflight: cannot reach {url}: {e}")
+        return 1
+    reqs = payload.get("requests") or []
+    print(f"{len(reqs)} request(s) in flight at {host}:{port}")
+    if not reqs:
+        return 0
+    cols = ("trace_id", "id", "tenant", "kind", "stage", "pos", "lanes",
+            "age_ms", "slack_ms")
+    rows = []
+    for e in reqs:
+        done = sum(
+            1 for p in (e.get("positions") or {}).values()
+            if p.get("stage") in ("delivered", "done")
+        )
+        slack = e.get("slack_ms")
+        rows.append((
+            str(e.get("trace_id", "")), str(e.get("id", "")),
+            str(e.get("tenant", "")), str(e.get("kind", "")),
+            str(e.get("stage", "")),
+            f"{done}/{e.get('n_positions', 0)}",
+            ",".join(str(x) for x in e.get("lanes") or []) or "-",
+            str(e.get("age_ms", "")),
+            str(slack) if slack is not None else "-",
+        ))
+    widths = [
+        max(len(c), *(len(r[i]) for r in rows))
+        for i, c in enumerate(cols)
+    ]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return 0
+
+
 def main(argv=None) -> int:
     from .configure import parse_and_configure
     from .systemd import system_unit, user_unit
@@ -401,6 +453,10 @@ def main(argv=None) -> int:
         from ..aot.pack import main_pack, main_warm
 
         return main_pack(cfg) if cfg.command == "pack" else main_warm(cfg)
+    if cfg.command == "inflight":
+        # live in-flight introspection against a running serve process
+        # (obs/inflight.py; --serve-host/--serve-port pick the target)
+        return run_inflight(cfg)
     if cfg.command in ("serve", "fleet"):
         # the analysis-serving front-end (fishnet_tpu/serve/): many
         # concurrent HTTP tenants multiplex into the same lane pool the

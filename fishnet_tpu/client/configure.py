@@ -160,7 +160,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", nargs="?", default="run",
                    choices=["run", "configure", "systemd", "systemd-user",
                             "license", "bench", "serve", "fleet",
-                            "pack", "warm"])
+                            "pack", "warm", "inflight"])
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--auto-update", action="store_true")
     p.add_argument("--conf", help="path to fishnet.ini")

@@ -10,6 +10,7 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..obs.trace import ctx_from_wire as _ctx_from_wire
 from .wire import (
     AnalysisPartBest,
     AnalysisPartMatrix,
@@ -26,6 +27,14 @@ class WorkPosition:
 
     position_index None marks a chunk-overlap warm-up position whose result
     is discarded (reference: src/queue.rs:642-681).
+
+    ctx is the request context stamped at the edge that created this
+    position (obs/trace.py make_ctx: trace_id/span_id/tenant/kind/
+    deadline_ms) or None when tracing is off / the request unsampled.
+    Pure observability metadata: it rides the wire next to the position
+    so supervisor replay and fleet re-dispatch — which reuse the same
+    WorkPosition objects — keep the causal chain, but it never reaches
+    an engine input and is excluded from position fingerprints.
     """
 
     work: Work
@@ -34,6 +43,7 @@ class WorkPosition:
     skip: bool
     root_fen: str
     moves: List[str]
+    ctx: Optional[dict] = None
 
 
 @dataclass
@@ -171,6 +181,7 @@ def chunk_to_wire(chunk: Chunk) -> dict:
                 "skip": wp.skip,
                 "root_fen": wp.root_fen,
                 "moves": wp.moves,
+                "ctx": wp.ctx,
             }
             for wp in chunk.positions
         ],
@@ -196,6 +207,7 @@ def chunk_from_wire(obj: dict) -> Chunk:
                 skip=p["skip"],
                 root_fen=p["root_fen"],
                 moves=list(p["moves"]),
+                ctx=_ctx_from_wire(p.get("ctx")),
             )
             for p in obj["positions"]
         ],

@@ -273,12 +273,28 @@ def main(argv=None) -> int:
             # one synthetic span per chunk, stamped on the SKEWED clock
             # (same epoch the mono fields report) — the supervisor must
             # shift it back onto the parent timeline when absorbing
-            send({"t": "trace", "events": [{
+            span = {
                 "name": "fake.search", "cat": "host", "ph": "X",
                 "ts": fake_mono() * 1e6,
                 "dur": args.hb_interval * 1e6,
                 "pid": os.getpid(), "tid": 1,
-            }]})
+            }
+            tids = sorted({
+                wp["ctx"]["trace_id"] for wp in positions
+                if isinstance(wp.get("ctx"), dict)
+                and wp["ctx"].get("trace_id")
+            })
+            if tids:
+                span["args"] = {"trace_ids": tids}
+            # request flow hops on this child's track, same skewed clock
+            # (like the real host's search span): the merged dump must
+            # show each request's causal chain crossing into this
+            # process — and into the survivor after a re-dispatch
+            send({"t": "trace", "events": [span] + [{
+                "name": "request", "cat": "request", "ph": "t",
+                "id": t_id, "ts": span["ts"],
+                "pid": os.getpid(), "tid": 1,
+            } for t_id in tids]})
 
         def send_partial(wp: dict, times: int = 1, cp: int = FAKE_CP) -> None:
             frame = {
@@ -287,6 +303,11 @@ def main(argv=None) -> int:
                 "fp": wire_position_fingerprint(wp),
                 "response": _fake_response(wp, cp),
             }
+            # echo request ctx like the real host (engine/host.py): the
+            # chaos continuity scenarios assert trace_ids survive a
+            # kill-mid-chunk through the journaled partials
+            if isinstance(wp.get("ctx"), dict):
+                frame["ctx"] = wp["ctx"]
             for _ in range(times):
                 send(frame)
 

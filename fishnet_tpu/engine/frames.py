@@ -9,7 +9,9 @@ Used on both sides of the pipe: synchronous helpers for the child host
 (blocking stdio) and an asyncio helper for the parent supervisor.
 Incremental `partial` frames (one position's response each, for the
 supervisor's session journal) are single-position and sit far under
-MAX_FRAME_BYTES by construction.
+MAX_FRAME_BYTES by construction; they optionally carry the position's
+request context (`ctx`, obs/trace.py CTX_KEYS) so a trace survives a
+mid-chunk kill through the journal.
 """
 from __future__ import annotations
 

@@ -13,10 +13,12 @@ Protocol (all frames are JSON objects with a "t" tag):
     hb     {phase, busy_s, seq}   ticker thread, every --hb-interval
     ready  {}                     warmup finished; chunks may be sent
     log    {msg}                  relayed to the parent's logger
-    partial {id, fp, response}    one finished position, streamed as the
-                                  engine's exactly-once delivery hook
+    partial {id, fp, response,    one finished position, streamed as the
+             ctx?}                engine's exactly-once delivery hook
                                   fires (feeds the supervisor's session
-                                  journal; fp = client/ipc.py fingerprint)
+                                  journal; fp = client/ipc.py fingerprint;
+                                  ctx = the position's request context
+                                  when it rode the chunk wire)
     ok     {id, responses}        chunk result (client/ipc.py wire form)
     err    {id, error}            chunk failed but the host is still sane
   parent → child
@@ -182,12 +184,18 @@ def main(argv=None) -> int:
 
     def emit_partial(wp, res) -> None:
         try:
-            send({
+            frame = {
                 "t": "partial",
                 "id": cur["id"],
                 "fp": position_fingerprint(wp),
                 "response": response_to_wire(res),
-            })
+            }
+            # request context rides the partial so the supervisor's
+            # journal (and a replay after a mid-chunk kill) can keep
+            # the position pinned to its originating trace
+            if wp.ctx:
+                frame["ctx"] = wp.ctx
+            send(frame)
         except OSError:
             pass  # parent gone mid-stream; the ticker exits for us
 
@@ -211,9 +219,21 @@ def main(argv=None) -> int:
         chunk = chunk_from_wire(msg["chunk"])
         cur["id"] = msg.get("id")
         phases.enter("search")
+        # sampled request contexts riding the chunk link this child's
+        # search span into each request's causal chain (flow id =
+        # trace_id, same as every other hop)
+        tids = sorted({
+            wp.ctx["trace_id"] for wp in chunk.positions
+            if wp.ctx and wp.ctx.get("trace_id")
+        })
+        tids = [t for t in tids if trace.sampled(t)]
         try:
             with trace.span("search", "host", id=msg.get("id"),
-                            positions=len(chunk.positions)):
+                            positions=len(chunk.positions),
+                            trace_ids=tids):
+                if recorder is not None:
+                    for t_id in tids:
+                        recorder.flow("request", t_id, "t")
                 responses = asyncio.run(engine.go_multiple(chunk))
         except Exception as e:
             send({

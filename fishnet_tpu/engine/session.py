@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..client.ipc import Chunk, PositionResponse, WorkPosition
+from ..obs import trace as obs_trace
 from ..client.wire import (
     MAX_CHUNK_POSITIONS,
     AnalysisWork,
@@ -77,6 +78,20 @@ class PositionRequest:
     level: int = 8
     deadline: Optional[float] = None
     priority: int = PRIORITY_BATCH
+    # Request context (obs/trace.py make_ctx) stamped by the frontend
+    # that accepted this request, or None when untraced. Observability
+    # metadata only — deliberately NOT part of _GroupKey, so tracing a
+    # request can never change how it chunks or what the engine sees.
+    # Stored as a hashable key/value tuple because the dataclass is
+    # frozen+hashable; ctx() rebuilds the dict.
+    trace_ctx: Optional[Tuple[Tuple[str, object], ...]] = None
+
+    def ctx(self) -> Optional[dict]:
+        return dict(self.trace_ctx) if self.trace_ctx else None
+
+    @staticmethod
+    def freeze_ctx(ctx: Optional[dict]):
+        return tuple(sorted(ctx.items())) if ctx else None
 
 
 @dataclass(frozen=True)
@@ -160,6 +175,7 @@ def requests_to_chunks(
                     skip=False,
                     root_fen=requests[i].fen,
                     moves=list(requests[i].moves),
+                    ctx=requests[i].ctx(),
                 )
                 for slot, i in enumerate(part)
             ]
@@ -232,7 +248,23 @@ class EngineSession:
         out: List[Optional[PositionResponse]] = [None] * len(requests)
 
         async def run(chunk: Chunk, indices: List[int]) -> None:
-            responses = await self.engine.go_multiple(chunk)
+            rec = obs_trace.RECORDER
+            # one chunk can merge positions from several traced requests
+            # (grouping is by work shape, not by caller) — the chunk
+            # span lists every trace_id and carries each sampled flow
+            tids = sorted({
+                wp.ctx["trace_id"] for wp in chunk.positions
+                if wp.ctx and wp.ctx.get("trace_id")
+            })
+            if rec is not None and tids:
+                sampled = [t for t in tids if obs_trace.sampled(t)]
+                with rec.span("serve.chunk", "serve",
+                              batch=chunk.work.id, trace_ids=sampled):
+                    for t in sampled:
+                        rec.flow("request", t, "t")
+                    responses = await self.engine.go_multiple(chunk)
+            else:
+                responses = await self.engine.go_multiple(chunk)
             for slot, i in enumerate(indices):
                 out[i] = responses[slot]
 

@@ -421,11 +421,25 @@ class SupervisedEngine(ChunkSubmit):
         fut.add_done_callback(_consume_exc)
         self._journal_reset(expect=[position_fingerprint(wp) for wp in wps])
         self._pending = (gid, fut)
+        # sampled request contexts riding the sub-chunk: the dispatch
+        # span lists them and carries each flow, so a replayed suffix
+        # after a kill shows up as another linked dispatch on the same
+        # trace_id (the ladder reuses the same WorkPositions, ctx intact)
+        tids = sorted({
+            wp.ctx["trace_id"] for wp in wps
+            if wp.ctx and wp.ctx.get("trace_id")
+        })
+        tids = [t for t in tids if obs_trace.sampled(t)]
         try:
             with obs_trace.span(
                 "supervisor.dispatch", "supervisor",
                 id=gid, batch=str(chunk.work.id), positions=len(wps),
+                trace_ids=tids,
             ):
+                rec = obs_trace.RECORDER
+                if rec is not None:
+                    for t_id in tids:
+                        rec.flow("request", t_id, "t")
                 await self._send(
                     {"t": "go", "id": gid, "chunk": chunk_to_wire(sub)}
                 )
@@ -600,7 +614,8 @@ class SupervisedEngine(ChunkSubmit):
         self._journal = {}
         self._journal_expect = set(expect)
 
-    def _journal_record(self, fp: str, wire: dict) -> None:
+    def _journal_record(self, fp: str, wire: dict,
+                        ctx: Optional[dict] = None) -> None:
         """Deliver one partial frame into the journal: the single write
         path (lint rule conc-journal-writer), called only from the
         reader task so the ladder can trust exactly-once contents."""
@@ -612,6 +627,15 @@ class SupervisedEngine(ChunkSubmit):
         self._journal[fp] = wire
         self.stats.partials += 1
         self._last_partial = time.monotonic()
+        # ctx rode the partial frame (engine/host.py): pin the journal
+        # event to its request so a post-kill harvest/replay stays on
+        # the same causal chain in the merged timeline
+        rec = obs_trace.RECORDER
+        if (rec is not None and ctx and ctx.get("trace_id")
+                and obs_trace.sampled(ctx["trace_id"])):
+            rec.instant("position.journaled", "request",
+                        **obs_trace.ctx_args(ctx, fp=fp))
+            rec.flow("request", ctx["trace_id"], "t")
         if self.on_partial is not None:
             try:
                 self.on_partial(fp, wire)
@@ -817,7 +841,10 @@ class SupervisedEngine(ChunkSubmit):
                         and isinstance(fp, str)
                         and isinstance(wire, dict)
                     ):
-                        self._journal_record(fp, wire)
+                        self._journal_record(
+                            fp, wire,
+                            ctx=obs_trace.ctx_from_wire(msg.get("ctx")),
+                        )
                 elif t == "log":
                     self.logger.info(f"engine host: {msg.get('msg', '')}")
         except asyncio.CancelledError:

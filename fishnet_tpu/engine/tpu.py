@@ -28,6 +28,7 @@ from ..client.wire import AnalysisWork, MoveWork, Score
 from ..models import nnue
 from ..ops import search as search_ops
 from ..ops.board import from_position, stack_boards
+from ..obs import inflight as obs_inflight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..ops.search import INF, MATE, search_batch_resumable
@@ -1350,7 +1351,7 @@ class _RefillJob:
         "remaining", "deadline", "hh", "hm", "depth", "delta_idx",
         "prev_score", "have_prev", "hardness", "scores", "pvs",
         "depth_reached", "best_move", "nodes_total", "nodes_depth",
-        "lane", "helpers",
+        "lane", "helpers", "traced", "t_spliced",
     )
 
     def __init__(self, entry, wp, pos, board, variant, target_depth,
@@ -1378,6 +1379,12 @@ class _RefillJob:
         self.nodes_depth = 0  # nodes across the current depth's attempts
         self.lane = -1  # primary lane index while admitted
         self.helpers: dict = {}  # helper lane index -> helper number h
+        # request-scoped tracing (host-side bookkeeping ONLY — nothing
+        # here ever reaches a device buffer): traced is the per-request
+        # sampling verdict hoisted out of the boundary loop, t_spliced
+        # the monotonic time this position won its first lane
+        self.traced = False
+        self.t_spliced = 0.0
 
 
 class _ChunkEntry:
@@ -1471,10 +1478,27 @@ class LaneScheduler:
                 )
                 continue
             hh, hm = TpuEngine._history_arrays([game], 1, variant)
-            jobs.append(_RefillJob(
+            job = _RefillJob(
                 entry, wp, pos, from_position(pos), variant, target_depth,
                 per_pos_budget, deadline, hh[0], hm[0],
-            ))
+            )
+            rec = obs_trace.RECORDER
+            ctx = wp.ctx
+            if ctx and ctx.get("trace_id"):
+                tid = ctx["trace_id"]
+                obs_inflight.REGISTRY.position(
+                    tid, wp.position_index or 0, "queued"
+                )
+                if rec is not None and obs_trace.sampled(tid):
+                    job.traced = True
+                    rec.instant(
+                        "position.queued", "request",
+                        **obs_trace.ctx_args(
+                            ctx, position_index=wp.position_index
+                        ),
+                    )
+                    rec.flow("request", tid, "t")
+            jobs.append(job)
         entry.n_open = len(jobs)
         if not jobs:
             entry.event.set()
@@ -1488,6 +1512,22 @@ class LaneScheduler:
         `entry.responses` through here, and only here, so the
         `on_response` streaming hook fires once per position."""
         entry.responses[wp.position_index] = response
+        ctx = wp.ctx
+        if ctx and ctx.get("trace_id"):
+            tid = ctx["trace_id"]
+            obs_inflight.REGISTRY.position(
+                tid, wp.position_index or 0, "delivered"
+            )
+            rec = obs_trace.RECORDER
+            if rec is not None and obs_trace.sampled(tid):
+                rec.instant(
+                    "position.delivered", "request",
+                    **obs_trace.ctx_args(
+                        ctx, position_index=wp.position_index,
+                        depth=response.depth, nodes=response.nodes,
+                    ),
+                )
+                rec.flow("request", tid, "t")
         hook = self.engine.on_response
         if hook is not None:
             try:
@@ -1498,6 +1538,20 @@ class LaneScheduler:
     def _finalize(self, job: _RefillJob, now: float,
                   error: Optional[str] = None) -> None:
         entry = job.entry
+        if job.traced and job.t_spliced > 0.0:
+            rec = obs_trace.RECORDER
+            if rec is not None:
+                # retroactive lane-residency span: first splice →
+                # finalize, one per position (re-admissions for deeper
+                # iterations reuse the lane inside this window)
+                rec.complete(
+                    "position.lane", job.t_spliced * 1e6,
+                    (now - job.t_spliced) * 1e6, cat="request",
+                    args=obs_trace.ctx_args(
+                        job.wp.ctx, position_index=job.wp.position_index,
+                        error=error,
+                    ),
+                )
         if error is not None:
             entry.error = error
         else:
@@ -1652,6 +1706,24 @@ class LaneScheduler:
         def admit_primary(job: _RefillJob, lane: int):
             job.lane = lane
             lane_job[lane] = job
+            wp = job.wp
+            if wp.ctx:
+                obs_inflight.REGISTRY.position(
+                    wp.ctx.get("trace_id"), wp.position_index or 0,
+                    "lane", lane=lane,
+                )
+            if job.traced:
+                job.t_spliced = time.monotonic()
+                rec = obs_trace.RECORDER
+                if rec is not None:
+                    rec.instant(
+                        "position.spliced", "request",
+                        **obs_trace.ctx_args(
+                            wp.ctx, position_index=wp.position_index,
+                            lane=lane,
+                        ),
+                    )
+                    rec.flow("request", wp.ctx["trace_id"], "t")
             a, b, _delta = window_for(job, 1)
             admit(lane, job.board, job.depth, job.remaining, a, b,
                   0, lane, job.hh, job.hm)
@@ -1758,6 +1830,33 @@ class LaneScheduler:
         def q_len_locked() -> int:
             with self._q_lock:
                 return len(self._pending)
+
+        def traced_snapshot():
+            """(ctx, lane, position_index) for every sampled job resident
+            in this segment — captured at dispatch, because by the time
+            the boundary is processed jobs may have parked/finalized."""
+            if obs_trace.RECORDER is None:
+                return ()
+            return [
+                (j.wp.ctx, j.lane, j.wp.position_index)
+                for j in active if j.traced
+            ]
+
+        def traced_residency(snapshot, t0_s: float, t1_s: float):
+            """Retroactive per-position residency spans for one segment:
+            which lanes a request's positions occupied while the device
+            ran — the finest grain of the request waterfall."""
+            rec = obs_trace.RECORDER
+            if rec is None:
+                return
+            for ctx, lane, idx in snapshot:
+                rec.complete(
+                    "segment.residency", t0_s * 1e6,
+                    (t1_s - t0_s) * 1e6, cat="request",
+                    args=obs_trace.ctx_args(
+                        ctx, lane=lane, position_index=idx
+                    ),
+                )
 
         if mesh is not None:
             def dispatch(st, table, n_steps):
@@ -2034,6 +2133,7 @@ class LaneScheduler:
                     helper_n = sum(len(j.helpers) for j in active)
                     shard_live = shard_occup()
                     disp_steps = seg
+                    seg_res = traced_snapshot()
                     t0 = time.monotonic()
                     with obs_trace.span("segment.dispatch", "engine",
                                         steps=seg, live=live_n):
@@ -2043,6 +2143,7 @@ class LaneScheduler:
                     ).reshape(-1)
                     n = int(n_arr.max())
                     wall = time.monotonic() - t0
+                    traced_residency(seg_res, t0, t0 + wall)
                     q_len = q_len_locked()
                     # ---- process finished lanes at the boundary
                     lane_done = stats.fetch(
@@ -2110,6 +2211,8 @@ class LaneScheduler:
                         shard_occup(), adm_shard,
                     )
                     pend_steps = seg
+                    pend_res = traced_snapshot()
+                    pend_t0 = time.monotonic()
                     with obs_trace.span("segment.dispatch", "engine",
                                         steps=seg):
                         pend = dispatch(state, tt, seg)
@@ -2133,6 +2236,8 @@ class LaneScheduler:
                             shard_occup(), None,
                         )
                         nxt_steps = seg
+                        nxt_res = traced_snapshot()
+                        nxt_t0 = time.monotonic()
                         with obs_trace.span("segment.dispatch", "engine",
                                             steps=seg, speculative=True):
                             nxt = dispatch(p_state, p_tt, seg)
@@ -2140,6 +2245,7 @@ class LaneScheduler:
                     summ, n, shard_steps = canon_summ(
                         stats.fetch(p_summ, "summary")
                     )
+                    traced_residency(pend_res, pend_t0, time.monotonic())
                     lane_done = summ[:, search_ops.SUM_DONE].astype(bool)
                     nodes_row = summ[:, search_ops.SUM_NODES]
                     # lanes whose park was already handled at an earlier
@@ -2199,6 +2305,8 @@ class LaneScheduler:
                         pend = nxt
                         pend_meta = nxt_meta
                         pend_steps = nxt_steps
+                        pend_res = nxt_res
+                        pend_t0 = nxt_t0
                         continue
                     state, n_adm, adm_shard = flush_adm(p_state)
                     if not active:
@@ -2210,6 +2318,8 @@ class LaneScheduler:
                         shard_occup(), adm_shard,
                     )
                     pend_steps = seg
+                    pend_res = traced_snapshot()
+                    pend_t0 = time.monotonic()
                     with obs_trace.span("segment.dispatch", "engine",
                                         steps=seg):
                         pend = dispatch(state, tt, seg)

@@ -359,11 +359,24 @@ class FleetCoordinator(ChunkSubmit):
         engine call and settles the ledger in its `finally`."""
         n = len(assigned)
         sub = replace(chunk, positions=[wp for _, wp in assigned])
+        # sampled request contexts in this sub-chunk: the dispatch span
+        # lists them and carries each flow, so a post-loss re-dispatch
+        # to a survivor shows up as another linked dispatch on the same
+        # trace_id (re-dispatch reuses the same WorkPositions)
+        tids = sorted({
+            wp.ctx["trace_id"] for _, wp in assigned
+            if wp.ctx and wp.ctx.get("trace_id")
+        })
+        tids = [t for t in tids if obs_trace.sampled(t)]
         try:
             with obs_trace.span(
                 "fleet.dispatch", "fleet", member=member.name, positions=n,
-                batch=str(chunk.work.id),
+                batch=str(chunk.work.id), trace_ids=tids,
             ):
+                rec = obs_trace.RECORDER
+                if rec is not None:
+                    for t_id in tids:
+                        rec.flow("request", t_id, "t")
                 responses = await member.engine.go_multiple(sub)
             if len(responses) != n:
                 raise EngineError(
@@ -428,10 +441,17 @@ class FleetCoordinator(ChunkSubmit):
             redispatched_fps=redisp,
         )
         self.loss_log.append(event)
+        # trace_ids about to be re-dispatched: the loss instant names
+        # them so the merged timeline shows which requests the death hit
+        tids = sorted({
+            wp.ctx["trace_id"] for _, wp in (leftover or [])
+            if wp.ctx and wp.ctx.get("trace_id")
+        })
         obs_trace.instant(
             "fleet.member-loss", "fleet", member=member.name,
             reason=reason, inflight=len(inflight_fps),
             acked=len(acked), redispatched=len(redisp),
+            trace_ids=[t for t in tids if obs_trace.sampled(t)],
         )
         self.logger.error(
             f"fleet: member {member.name} lost ({reason}); "

@@ -35,7 +35,7 @@ from urllib.parse import urlsplit
 from ..client.ipc import Chunk, PositionResponse, responses_from_wire
 from ..client.wire import AnalysisWork, MoveWork
 from ..engine.base import EngineError
-from ..engine.session import PRIORITY_BATCH, ChunkSubmit
+from ..engine.session import PRIORITY_BATCH, ChunkSubmit, PositionRequest
 from ..serve.protocol import ServeRequest, request_to_json
 
 DEFAULT_TIMEOUT_S = 30.0
@@ -71,11 +71,20 @@ def chunk_to_serve_request(chunk: Chunk, now: Optional[float] = None) -> dict:
     positions = tuple(
         (wp.root_fen, tuple(wp.moves)) for wp in chunk.positions
     )
+    # request context crosses the HTTP hop per position (lint rule
+    # obs-orphan-span): a re-dispatched sub-chunk can mix positions from
+    # different upstream requests, so each slot ships its own ctx and
+    # the remote edge keeps the original trace_id instead of minting one
+    ctxs = tuple(
+        PositionRequest.freeze_ctx(wp.ctx) for wp in chunk.positions
+    )
+    position_ctx = ctxs if any(c is not None for c in ctxs) else ()
     if isinstance(work, MoveWork):
         req = ServeRequest(
             kind="bestmove", positions=positions, id=str(work.id),
             variant=chunk.variant, level=work.level.level,
             timeout_ms=min(timeout_ms, 600_000),
+            position_ctx=position_ctx,
         )
     else:
         assert isinstance(work, AnalysisWork)
@@ -86,6 +95,7 @@ def chunk_to_serve_request(chunk: Chunk, now: Optional[float] = None) -> dict:
             nodes=max(min(nodes, 1_000_000_000), 1),
             priority=PRIORITY_BATCH,
             timeout_ms=min(timeout_ms, 600_000),
+            position_ctx=position_ctx,
         )
     return request_to_json(req)
 

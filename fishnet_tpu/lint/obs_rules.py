@@ -14,11 +14,24 @@ Rules:
                    with external logs/dashboards (e.g. the sqlite sink's
                    row timestamps in client/stats.py) — is marked inline:
                    `# fishnet-lint: disable=obs-wall-clock`.
+  obs-orphan-span  a frame/dispatch site that hands work across a
+                   process boundary without propagating the request
+                   context (obs/trace.py CTX_KEYS). A hop that drops ctx
+                   orphans every downstream span — the request's causal
+                   chain dead-ends at that boundary and trace_report
+                   --request can no longer stitch the waterfall. Three
+                   site shapes are checked: a `"t": "partial"` frame
+                   built in a function that never touches ctx; a
+                   `"t": "go"` frame whose "chunk" payload is not
+                   serialized by chunk_to_wire (which carries each
+                   WorkPosition's ctx, proven by the wire-schema lint);
+                   and a ServeRequest(...) construction without the
+                   position_ctx field.
 """
 from __future__ import annotations
 
 import ast
-from typing import List, Set
+from typing import List, Optional, Set, Tuple
 
 from .core import Finding, Project, SourceFile, dotted, register_family
 
@@ -51,6 +64,106 @@ def _time_call_sites(src: SourceFile) -> List[ast.Call]:
         elif isinstance(fn, ast.Name) and fn.id in bare_names:
             sites.append(node)
     return sites
+
+
+def _dict_key(node: ast.Dict, key: str) -> Optional[ast.AST]:
+    """Value expression for a constant string key in a dict literal."""
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and k.value == key:
+            return v
+    return None
+
+
+def _mentions_ctx(fn: Optional[ast.AST]) -> bool:
+    """Does this function touch the request-context field at all? Any
+    spelling counts — the `ctx`/`position_ctx` name, a `.ctx` attribute,
+    or the "ctx" string key (`wp.get("ctx")`, `frame["ctx"]`)."""
+    if fn is None:
+        return False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in ("ctx", "position_ctx"):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "ctx":
+            return True
+        if isinstance(node, ast.Constant) and node.value == "ctx":
+            return True
+    return False
+
+
+def _last_component(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _dispatch_sites(
+    src: SourceFile,
+) -> List[Tuple[str, ast.AST, Optional[ast.AST]]]:
+    """(kind, node, enclosing function) for every cross-process hand-off
+    in this file: work-carrying pipe frames and serve dispatch bodies."""
+    sites: List[Tuple[str, ast.AST, Optional[ast.AST]]] = []
+
+    def visit(node: ast.AST, fn: Optional[ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        if isinstance(node, ast.Dict):
+            tag = _dict_key(node, "t")
+            if isinstance(tag, ast.Constant):
+                if tag.value == "partial":
+                    sites.append(("partial", node, fn))
+                elif tag.value == "go" and _dict_key(node, "chunk") is not None:
+                    sites.append(("go", node, fn))
+        if isinstance(node, ast.Call):
+            if _last_component(dotted(node.func)) == "ServeRequest":
+                sites.append(("serve-request", node, fn))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn)
+
+    visit(src.tree, None)
+    return sites
+
+
+@register_family("obs")
+def check_obs_orphan_span(project: Project) -> List[Finding]:
+    """Context propagation: no hop across a process boundary may drop
+    the request context."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu"):
+        for kind, node, fn in _dispatch_sites(src):
+            if kind == "partial":
+                if _mentions_ctx(fn):
+                    continue
+                msg = (
+                    "per-position `partial` frame built without touching "
+                    "the request context — a replayed position loses its "
+                    "trace here; forward `wp.ctx` into the frame "
+                    "(engine/host.py emit_partial is the reference shape)"
+                )
+            elif kind == "go":
+                chunk = _dict_key(node, "chunk")
+                if (isinstance(chunk, ast.Call) and _last_component(
+                        dotted(chunk.func)) == "chunk_to_wire"):
+                    continue  # the wire schema carries per-position ctx
+                if _mentions_ctx(fn):
+                    continue
+                msg = (
+                    "`go` frame ships a chunk payload not serialized by "
+                    "chunk_to_wire — every position crosses the pipe "
+                    "without its request context and the trace dead-ends "
+                    "at this hop"
+                )
+            else:  # serve-request
+                if any(kw.arg == "position_ctx" or kw.arg is None
+                       for kw in node.keywords):
+                    continue  # explicit ctx (or a **splat we can't see into)
+                msg = (
+                    "ServeRequest built without position_ctx — the HTTP "
+                    "dispatch hop drops every position's request context "
+                    "and the remote edge mints a fresh trace_id instead "
+                    "of continuing the caller's; forward "
+                    "`position_ctx=...` (fleet/remote.py "
+                    "chunk_to_serve_request is the reference shape)"
+                )
+            findings.append(src.finding("obs-orphan-span", node, msg))
+    return findings
 
 
 @register_family("obs")

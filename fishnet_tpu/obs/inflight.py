@@ -1,0 +1,137 @@
+"""Live in-flight request registry: what is the serving stack doing
+RIGHT NOW, per request.
+
+The trace ring answers "where did request X spend its 900 ms" after the
+fact; this registry answers "where is request X right now" while it is
+still in flight — the stage it has reached (received → admitted →
+dispatched → lane → done), the lanes its positions occupy, its age and
+its remaining deadline slack. `GET /debug/requests` on the serve server
+and the `fishnet-tpu inflight` CLI both render snapshot().
+
+Keyed by trace_id: the serve edge begin()s an entry when it stamps the
+request context, every later hop that still runs in the same process
+(admission, chunk dispatch, the LaneScheduler's splice/boundary path)
+updates it by the trace_id riding the context, and the edge end()s it
+when the response leaves. Hops in OTHER processes (a supervised engine
+host child) update their own process-local registry — which nobody
+serves — so their writes are harmless no-ops from the operator's point
+of view; stage granularity at the serve surface is whatever ran
+in-process, which for the python/in-process backends includes lanes.
+
+Always on: entries are a few dict writes per request, so there is no
+enable switch to forget. Unknown trace_ids are ignored (the lichess
+client path stamps contexts nobody begin()s). Pure stdlib.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["InflightRegistry", "REGISTRY"]
+
+# Stage ordering for the coarse request-level stage: position updates
+# never move a request backwards (a replayed position re-entering
+# "queued" must not hide that the request had reached the lanes).
+_STAGE_ORDER = (
+    "received", "admitted", "dispatched", "queued", "lane", "delivered",
+    "done",
+)
+_STAGE_RANK = {s: i for i, s in enumerate(_STAGE_ORDER)}
+
+
+class InflightRegistry:
+    """Thread-safe map of trace_id → live request state."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, dict] = {}
+
+    def begin(self, trace_id: str, req_id: str, tenant: str, kind: str,
+              deadline_mono_s: Optional[float] = None,
+              n_positions: int = 0) -> None:
+        if not trace_id:
+            return
+        with self._lock:
+            self._entries[trace_id] = {
+                "trace_id": trace_id,
+                "id": req_id,
+                "tenant": tenant,
+                "kind": kind,
+                "stage": "received",
+                "t0_mono_s": time.monotonic(),
+                "deadline_mono_s": deadline_mono_s,
+                "n_positions": int(n_positions),
+                "positions": {},
+            }
+
+    def stage(self, trace_id: Optional[str], stage: str) -> None:
+        if not trace_id:
+            return
+        with self._lock:
+            entry = self._entries.get(trace_id)
+            if entry is None:
+                return
+            if _STAGE_RANK.get(stage, 0) >= _STAGE_RANK.get(
+                    entry["stage"], 0):
+                entry["stage"] = stage
+
+    def position(self, trace_id: Optional[str], pos_index: int,
+                 stage: str, lane: Optional[int] = None) -> None:
+        """Per-position progress from the LaneScheduler: the position's
+        own stage plus the lane it occupies once spliced."""
+        if not trace_id:
+            return
+        with self._lock:
+            entry = self._entries.get(trace_id)
+            if entry is None:
+                return
+            entry["positions"][int(pos_index)] = {
+                "stage": stage,
+                "lane": lane,
+            }
+            if _STAGE_RANK.get(stage, 0) > _STAGE_RANK.get(
+                    entry["stage"], 0):
+                entry["stage"] = stage
+
+    def end(self, trace_id: Optional[str]) -> None:
+        if not trace_id:
+            return
+        with self._lock:
+            self._entries.pop(trace_id, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> List[dict]:
+        """JSON-safe copies with derived age/slack, oldest first."""
+        now = time.monotonic()
+        with self._lock:
+            entries = [
+                (e, dict(e, positions=dict(e["positions"])))
+                for e in self._entries.values()
+            ]
+        out: List[dict] = []
+        for src, e in sorted(entries, key=lambda p: p[0]["t0_mono_s"]):
+            deadline = e.pop("deadline_mono_s")
+            t0 = e.pop("t0_mono_s")
+            e["age_ms"] = round((now - t0) * 1e3, 1)
+            e["slack_ms"] = (
+                round((deadline - now) * 1e3, 1)
+                if deadline is not None else None
+            )
+            e["lanes"] = sorted({
+                p["lane"] for p in e["positions"].values()
+                if p.get("lane") is not None
+            })
+            e["positions"] = {
+                str(k): v for k, v in sorted(e["positions"].items())
+            }
+            out.append(e)
+        return out
+
+
+# Process-local singleton; the serve server and the in-process scheduler
+# share it, child processes each get their own inert copy.
+REGISTRY = InflightRegistry()

@@ -29,6 +29,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SloRecorder",
     "serve",
     "serve_from_settings",
 ]
@@ -253,6 +254,62 @@ class MetricsRegistry:
             lines.append(f"# TYPE {name} {m.kind}")
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+
+class SloRecorder:
+    """Per-tenant/per-kind SLO accounting for the serving stack.
+
+    One observe() per finished request records its end-to-end latency
+    and the same latency split into where the time went:
+
+    - queue-wait: admission waiting room (deadline-ordered heap);
+    - device: the engine's own search wall clock (the max across the
+      request's positions — they run concurrently in the lane pool);
+    - host: everything else — chunking, pipe hops, serde, the serve
+      loop itself (total − queue − device, floored at zero).
+
+    Metric names follow the serve stack's name-embedded label scheme
+    (`fishnet_serve_latency_ms_<tenant>`): per (kind, tenant) —
+    `fishnet_slo_latency_ms_<kind>_<tenant>` plus _queue_ms/_device_ms/
+    _host_ms histograms and deadline_miss/shed/requests counters. The
+    p50/p99 SLO tier (ROADMAP item 5) and bench.py's serve_slo row read
+    these straight out of render_prometheus()/snapshot()."""
+
+    __slots__ = ("registry", "prefix")
+
+    def __init__(self, registry: Optional["MetricsRegistry"] = None,
+                 prefix: str = "fishnet_slo") -> None:
+        self.registry = registry if registry is not None else REGISTRY
+        self.prefix = prefix
+
+    def _hist(self, what: str, kind: str, tenant: str) -> Histogram:
+        return self.registry.histogram(
+            f"{self.prefix}_{what}_ms_{kind}_{tenant}",
+            f"request {what} (ms) for kind {kind}, tenant {tenant}",
+        )
+
+    def _ctr(self, what: str, kind: str, tenant: str) -> Counter:
+        return self.registry.counter(
+            f"{self.prefix}_{what}_total_{kind}_{tenant}",
+            f"{what} for kind {kind}, tenant {tenant}",
+        )
+
+    def observe(self, tenant: str, kind: str, total_ms: float,
+                queue_ms: float = 0.0, device_ms: float = 0.0,
+                deadline_missed: bool = False) -> None:
+        queue_ms = max(0.0, min(queue_ms, total_ms))
+        device_ms = max(0.0, min(device_ms, total_ms - queue_ms))
+        host_ms = max(0.0, total_ms - queue_ms - device_ms)
+        self._ctr("requests", kind, tenant).inc()
+        self._hist("latency", kind, tenant).observe(total_ms)
+        self._hist("queue", kind, tenant).observe(queue_ms)
+        self._hist("device", kind, tenant).observe(device_ms)
+        self._hist("host", kind, tenant).observe(host_ms)
+        if deadline_missed:
+            self._ctr("deadline_miss", kind, tenant).inc()
+
+    def shed(self, tenant: str, kind: str) -> None:
+        self._ctr("shed", kind, tenant).inc()
 
 
 # The process-wide default registry every subsystem feeds.

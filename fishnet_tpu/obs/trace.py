@@ -35,6 +35,7 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
@@ -42,10 +43,15 @@ __all__ = [
     "ClockSync",
     "TraceRecorder",
     "counter",
+    "ctx_args",
+    "flow",
     "install",
     "install_from_settings",
     "instant",
+    "make_ctx",
+    "new_id",
     "now_us",
+    "sampled",
     "span",
     "uninstall",
 ]
@@ -209,6 +215,40 @@ class TraceRecorder:
         })
         self.emitted += 1
 
+    def flow(self, name: str, flow_id: str, phase: str = "t",
+             cat: str = "app", ts_us: Optional[float] = None,
+             tid: Optional[int] = None,
+             args: Optional[dict] = None) -> None:
+        """The span-link primitive: a Chrome flow event tying slices on
+        different tracks (threads, processes) into one causal chain.
+
+        phase "s" starts a flow, "t" carries it through an intermediate
+        slice, "f" terminates it. Events sharing the same `flow_id`
+        render as arrows in Perfetto; a request's trace_id is its flow
+        id, so every hop a request takes — HTTP edge, admission, chunk
+        dispatch, lane splice, delivery — hangs off one arrow chain even
+        after absorb() merges the rings of four processes (flow ids are
+        strings, immune to the timestamp shift)."""
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": phase,
+            "id": str(flow_id),
+            "ts": now_us() if ts_us is None else ts_us,
+            "pid": self.pid,
+            "tid": self._tid() if tid is None else tid,
+        }
+        if phase == "f":
+            # bind to the enclosing slice's end, not the next slice's
+            # start — the chain must not imply causality that isn't there
+            ev["bp"] = "e"
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+        self.emitted += 1
+
     # ------------------------------------------------- cross-process IO
 
     def drain(self) -> List[dict]:
@@ -345,10 +385,106 @@ class ClockSync:
         return self.offset_us
 
 
+# ----------------------------------------------------- request context
+#
+# A request context is the 5-tuple the tentacles of a single user
+# request carry across every process boundary:
+#
+#     {"trace_id", "span_id", "tenant", "kind", "deadline_ms"}
+#
+# represented as a plain JSON-safe dict so it rides the existing wire
+# dicts and pipe frames untouched (client/ipc.py chunk wire field
+# "ctx", engine/frames.py partial frames, serve protocol JSON).
+# trace_id names the whole request and doubles as its flow id; span_id
+# names the hop that stamped the context (the parent span of everything
+# downstream). The context is pure metadata: it must never reach an
+# engine input or a _GroupKey — search results are bit-identical with
+# tracing on or off.
+
+CTX_KEYS = ("trace_id", "span_id", "tenant", "kind", "deadline_ms")
+
+
+def new_id() -> str:
+    """A fresh 16-hex-char trace/span id (64 random bits)."""
+    return os.urandom(8).hex()
+
+
+def make_ctx(tenant: str, kind: str, deadline_ms: Optional[int] = None,
+             trace_id: Optional[str] = None,
+             span_id: Optional[str] = None) -> dict:
+    """Stamp a request context at an edge (serve front-end, lichess
+    client). Reuses a caller-supplied trace_id (an upstream header)
+    or mints one."""
+    return {
+        "trace_id": trace_id or new_id(),
+        "span_id": span_id or new_id(),
+        "tenant": str(tenant or "")[:32],
+        "kind": str(kind or "")[:16],
+        "deadline_ms": int(deadline_ms) if deadline_ms else None,
+    }
+
+
+def ctx_from_wire(obj) -> Optional[dict]:
+    """Validate a context read off a wire dict / pipe frame. Foreign
+    junk degrades to None (no context) rather than crashing a frame
+    reader mid-chunk."""
+    if not isinstance(obj, dict) or not obj.get("trace_id"):
+        return None
+    ctx = {k: obj.get(k) for k in CTX_KEYS}
+    ctx["trace_id"] = str(ctx["trace_id"])[:32]
+    ctx["span_id"] = str(ctx.get("span_id") or "")[:32]
+    return ctx
+
+
+def ctx_args(ctx: Optional[dict], **extra) -> dict:
+    """Span-args annotation for a context: every per-request span gets
+    args.trace_id so trace_report can reassemble the waterfall even
+    where flow arrows were evicted from a ring."""
+    if not ctx:
+        return extra
+    out = {"trace_id": ctx.get("trace_id"),
+           "tenant": ctx.get("tenant"),
+           "kind": ctx.get("kind")}
+    out.update(extra)
+    return out
+
+
+def sampled(trace_id: str) -> bool:
+    """Deterministic per-request sampling decision, shared by every
+    process that sees the id: the same trace_id hashes to the same
+    verdict on the serve edge, the supervisor, and the engine host, so
+    a sampled request is traced at EVERY hop or none (no half
+    waterfalls). Rate from FISHNET_TPU_TRACE_SAMPLE in [0, 1]."""
+    rate = _sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return (zlib.crc32(trace_id.encode("utf-8", "replace")) & 0xFFFFFFFF) \
+        < rate * 4294967296.0
+
+
+def _sample_rate() -> float:
+    from ..utils import settings
+
+    raw = settings.get_str("FISHNET_TPU_TRACE_SAMPLE")
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except (TypeError, ValueError):
+        return 1.0
+
+
 # ------------------------------------------------- module-level helpers
 #
 # Convenience wrappers for non-hot-path call sites; all are free when
 # tracing is off. Hot loops should hoist `rec = trace.RECORDER` instead.
+
+
+def flow(name: str, flow_id: str, phase: str = "t", cat: str = "app",
+         ts_us: Optional[float] = None, args: Optional[dict] = None) -> None:
+    rec = RECORDER
+    if rec is not None:
+        rec.flow(name, flow_id, phase, cat, ts_us=ts_us, args=args)
 
 
 def span(name: str, cat: str = "app", **args):

@@ -33,6 +33,7 @@ from ..engine.session import (
     PRIORITY_INTERACTIVE,
     PositionRequest,
 )
+from ..obs import trace as obs_trace
 
 MAX_POSITIONS_PER_REQUEST = 64
 MAX_MOVES_PER_POSITION = 1024
@@ -51,7 +52,17 @@ class ProtocolError(ValueError):
 
 @dataclass(frozen=True)
 class ServeRequest:
-    """One parsed request body (either endpoint)."""
+    """One parsed request body (either endpoint).
+
+    trace_id carries an upstream trace across the HTTP hop (the
+    X-Fishnet-Trace body field / header; the fleet's remote members use
+    it to keep one causal chain when a chunk is re-dispatched to a
+    `fishnet-tpu serve` endpoint). position_ctx is the per-position
+    request context in the same order as positions — per-position
+    because a re-dispatched sub-chunk can mix positions from different
+    upstream requests. Both default to "absent" and never influence the
+    search; they are frozen tuples so the dataclass stays hashable.
+    """
 
     kind: str  # "analysis" | "bestmove"
     positions: Tuple[Tuple[str, Tuple[str, ...]], ...]  # (fen, moves)
@@ -64,6 +75,8 @@ class ServeRequest:
     level: int = 8
     priority: int = PRIORITY_BATCH
     timeout_ms: Optional[int] = None
+    trace_id: str = ""
+    position_ctx: Tuple[Optional[Tuple[Tuple[str, object], ...]], ...] = ()
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -71,7 +84,7 @@ def _require(cond: bool, msg: str) -> None:
         raise ProtocolError(msg)
 
 
-def _parse_positions(obj: dict) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
+def _parse_positions(obj: dict):
     raw = obj.get("positions")
     _require(isinstance(raw, list) and raw, "positions must be a non-empty list")
     _require(
@@ -79,6 +92,7 @@ def _parse_positions(obj: dict) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
         f"at most {MAX_POSITIONS_PER_REQUEST} positions per request",
     )
     out = []
+    ctxs = []
     for p in raw:
         _require(isinstance(p, dict), "each position must be an object")
         fen = p.get("fen")
@@ -93,7 +107,13 @@ def _parse_positions(obj: dict) -> Tuple[Tuple[str, Tuple[str, ...]], ...]:
             f"at most {MAX_MOVES_PER_POSITION} moves per position",
         )
         out.append((fen, tuple(moves)))
-    return tuple(out)
+        # foreign/garbage ctx degrades to None, never a 400: the context
+        # is observability metadata, not part of the request contract
+        ctx = obs_trace.ctx_from_wire(p.get("ctx"))
+        ctxs.append(PositionRequest.freeze_ctx(ctx))
+    if not any(c is not None for c in ctxs):
+        ctxs = []
+    return tuple(out), tuple(ctxs)
 
 
 def _opt_int(obj: dict, key: str, lo: int, hi: int) -> Optional[int]:
@@ -131,9 +151,15 @@ def parse_request(kind: str, obj: object) -> ServeRequest:
         isinstance(level, int) and not isinstance(level, bool) and 1 <= level <= 8,
         "level must be an integer in 1..8",
     )
+    trace_id = obj.get("trace_id", "")
+    _require(
+        isinstance(trace_id, str) and len(trace_id) <= 32,
+        "trace_id must be a string <= 32 chars",
+    )
+    positions, position_ctx = _parse_positions(obj)
     return ServeRequest(
         kind=kind,
-        positions=_parse_positions(obj),
+        positions=positions,
         id=rid,
         tenant=tenant,
         variant=variant,
@@ -143,6 +169,8 @@ def parse_request(kind: str, obj: object) -> ServeRequest:
         level=level,
         priority=_PRIORITY_NAMES[priority_name],
         timeout_ms=_opt_int(obj, "timeout_ms", 1, 600_000),
+        trace_id=trace_id,
+        position_ctx=position_ctx,
     )
 
 
@@ -155,6 +183,12 @@ def request_to_json(req: ServeRequest) -> dict:
         ],
         "priority": _PRIORITY_VALUES[req.priority],
     }
+    if req.position_ctx:
+        for slot, frozen in enumerate(req.position_ctx):
+            if frozen is not None:
+                out["positions"][slot]["ctx"] = dict(frozen)
+    if req.trace_id:
+        out["trace_id"] = req.trace_id
     if req.id:
         out["id"] = req.id
     if req.tenant != "default":
@@ -171,12 +205,20 @@ def request_to_json(req: ServeRequest) -> dict:
 
 
 def to_position_requests(
-    req: ServeRequest, deadline: float
+    req: ServeRequest, deadline: float, ctx: Optional[dict] = None
 ) -> List[PositionRequest]:
     """Expand one admitted request into PositionRequests sharing the
-    deadline the admission controller stamped on it."""
-    return [
-        PositionRequest(
+    deadline the admission controller stamped on it.
+
+    ctx is the request context the HTTP edge stamped (obs/trace.py
+    make_ctx); positions that arrived with their OWN wire context — a
+    fleet re-dispatch forwarding someone else's positions — keep it,
+    so the original edge's trace_id survives the extra HTTP hop."""
+    out = []
+    for slot, (fen, moves) in enumerate(req.positions):
+        own = (req.position_ctx[slot]
+               if slot < len(req.position_ctx) else None)
+        out.append(PositionRequest(
             fen=fen,
             moves=moves,
             variant=req.variant,
@@ -187,9 +229,10 @@ def to_position_requests(
             level=req.level,
             deadline=deadline,
             priority=req.priority,
-        )
-        for fen, moves in req.positions
-    ]
+            trace_ctx=own if own is not None
+            else PositionRequest.freeze_ctx(ctx),
+        ))
+    return out
 
 
 def results_to_json(

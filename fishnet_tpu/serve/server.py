@@ -30,7 +30,9 @@ from typing import Dict, Optional, Tuple
 from ..client.logger import Logger
 from ..engine.base import EngineError
 from ..engine.session import EngineSession
+from ..obs import inflight as obs_inflight
 from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils import settings
 from .admission import AdmissionController, Shed
 from .protocol import (
@@ -40,6 +42,10 @@ from .protocol import (
     shed_to_json,
     to_position_requests,
 )
+
+# HTTP header carrying an upstream trace id into the serve edge (the
+# body field "trace_id" wins when both are present).
+TRACE_HEADER = "x-fishnet-trace"
 
 MAX_HEADER_BYTES = 32768
 MAX_BODY_BYTES = 4 * 1024 * 1024
@@ -97,6 +103,8 @@ class ServeApp:
         self.admission = AdmissionController(
             max_inflight, max_queue, registry=self.registry
         )
+        self.slo = obs_metrics.SloRecorder(self.registry)
+        self.inflight = obs_inflight.REGISTRY
         self._server: Optional[asyncio.AbstractServer] = None
         self._draining = False
         self._open_requests = 0
@@ -258,6 +266,11 @@ class ServeApp:
                 "queued": queued,
                 "drain_rate_pos_per_s": round(self.admission.drain_rate(), 3),
             }, {}
+        if path == "/debug/requests":
+            if method != "GET":
+                return 405, {"error": "use GET"}, {}
+            reqs = self.inflight.snapshot()
+            return 200, {"inflight": len(reqs), "requests": reqs}, {}
         kind = _ENDPOINTS.get(path)
         if kind is None:
             return 404, {"error": f"no such endpoint {path}"}, {}
@@ -273,37 +286,96 @@ class ServeApp:
             sreq = parse_request(kind, obj)
         except ProtocolError as e:
             return 400, {"error": str(e)}, {}
-        return await self._serve_request(sreq)
+        return await self._serve_request(
+            sreq, upstream_trace=headers.get(TRACE_HEADER, "")
+        )
 
-    async def _serve_request(self, sreq) -> Tuple[int, dict, Dict[str, str]]:
+    async def _serve_request(
+        self, sreq, upstream_trace: str = ""
+    ) -> Tuple[int, dict, Dict[str, str]]:
         timeout_ms = min(
             sreq.timeout_ms or self.default_timeout_ms, self.default_timeout_ms
         )
         t0 = time.monotonic()
         deadline = t0 + timeout_ms / 1000.0
+        # The edge stamp: every request gets a context (the in-flight
+        # registry and SLO accounting key on it even with tracing off);
+        # spans/flow links are additionally gated on the recorder and
+        # the deterministic sampling verdict for this trace_id.
+        ctx = obs_trace.make_ctx(
+            sreq.tenant, sreq.kind, deadline_ms=timeout_ms,
+            trace_id=sreq.trace_id or upstream_trace or None,
+        )
+        tid = ctx["trace_id"]
+        rec = obs_trace.RECORDER
+        traced = rec is not None and obs_trace.sampled(tid)
+        self.inflight.begin(
+            tid, sreq.id, sreq.tenant, sreq.kind,
+            deadline_mono_s=deadline, n_positions=len(sreq.positions),
+        )
         self._open_requests += 1
         try:
-            try:
-                ticket = await self.admission.admit(
-                    sreq.tenant, len(sreq.positions), deadline, sreq.priority
+            with (rec.span("http.request", "serve",
+                           **obs_trace.ctx_args(ctx, id=sreq.id,
+                                                n=len(sreq.positions)))
+                  if traced else obs_trace.NULL_SPAN):
+                if traced:
+                    rec.flow("request", tid, "s")
+                try:
+                    with (rec.span("serve.admission", "serve",
+                                   **obs_trace.ctx_args(ctx))
+                          if traced else obs_trace.NULL_SPAN):
+                        ticket = await self.admission.admit(
+                            sreq.tenant, len(sreq.positions), deadline,
+                            sreq.priority,
+                        )
+                except Shed as e:
+                    self.slo.shed(sreq.tenant, sreq.kind)
+                    return 429, shed_to_json(e.retry_after, e.reason), {
+                        "Retry-After": str(e.retry_after)
+                    }
+                self.inflight.stage(tid, "admitted")
+                queue_ms = (time.monotonic() - t0) * 1000.0
+                ok = False
+                try:
+                    self.inflight.stage(tid, "dispatched")
+                    responses = await self.session.submit_many(
+                        to_position_requests(sreq, deadline, ctx=ctx)
+                    )
+                    ok = True
+                except EngineError as e:
+                    self.logger.error(f"serve: engine error: {e}")
+                    return 500, {"error": f"engine error: {e}"}, {}
+                finally:
+                    self.admission.release(ticket, ok=ok)
+                now = time.monotonic()
+                total_ms = (now - t0) * 1000.0
+                device_ms = max(
+                    (r.time_s for r in responses), default=0.0
+                ) * 1000.0
+                self.slo.observe(
+                    sreq.tenant, sreq.kind, total_ms,
+                    queue_ms=queue_ms,
+                    device_ms=device_ms,
+                    deadline_missed=now > deadline,
                 )
-            except Shed as e:
-                return 429, shed_to_json(e.retry_after, e.reason), {
-                    "Retry-After": str(e.retry_after)
-                }
-            ok = False
-            try:
-                responses = await self.session.submit_many(
-                    to_position_requests(sreq, deadline)
-                )
-                ok = True
-            except EngineError as e:
-                self.logger.error(f"serve: engine error: {e}")
-                return 500, {"error": f"engine error: {e}"}, {}
-            finally:
-                self.admission.release(ticket, ok=ok)
-            return 200, results_to_json(sreq, responses, time.monotonic() - t0), {}
+                if traced:
+                    # the histogram observation rides the dump so
+                    # trace_report --request can crosscheck the
+                    # reconstructed waterfall against what the SLO
+                    # accounting actually recorded (same idiom as the
+                    # segment spans carrying their SyncStats args)
+                    rec.instant(
+                        "slo.observe", "serve",
+                        **obs_trace.ctx_args(
+                            ctx, total_ms=total_ms, queue_ms=queue_ms,
+                            device_ms=device_ms,
+                            deadline_missed=now > deadline,
+                        ))
+                    rec.flow("request", tid, "f")
+                return 200, results_to_json(sreq, responses, now - t0), {}
         finally:
+            self.inflight.end(tid)
             self._open_requests -= 1
             if self._draining and self._open_requests == 0:
                 self._drained.set()
@@ -317,6 +389,10 @@ async def run_serve(cfg) -> int:
     from ..client.wire import EngineFlavor
 
     logger = Logger(verbose=cfg.verbose)
+    if obs_trace.RECORDER is None:
+        # serve is its own trace edge: the request-scoped http/admission
+        # spans and the flow chain start here (no-op without TRACE_DIR)
+        obs_trace.install_from_settings("serve")
     host = cfg.serve_host or settings.get_str("FISHNET_TPU_SERVE_HOST")
     port = (
         cfg.serve_port
@@ -381,5 +457,13 @@ async def run_serve(cfg) -> int:
     await app.drain_and_stop()
     await session.close()
     await engine.close()
+    rec = obs_trace.RECORDER
+    trace_dir = settings.get_str("FISHNET_TPU_TRACE_DIR")
+    if rec is not None and trace_dir:
+        # the serve ring holds the merged timeline (supervised members'
+        # events were absorbed as they streamed); one dump at drain is
+        # the whole request waterfall, edge to lane
+        path = rec.flight_dump(trace_dir, "serve-final")
+        logger.info(f"serve: trace dumped to {path}")
     logger.headline("serve: bye.")
     return 0

@@ -255,6 +255,17 @@ SETTINGS: Tuple[Setting, ...] = (
         engine=True,
     ),
     Setting(
+        name="FISHNET_TPU_TRACE_SAMPLE",
+        kind="str",
+        default="1.0",
+        doc="Fraction of requests that get per-request lifecycle "
+            "tracing (request-scoped spans + flow links, obs/trace.py "
+            "sampled()): a float in [0, 1]. The decision hashes the "
+            "trace_id, so every process traces the same subset of "
+            "requests. Only meaningful with FISHNET_TPU_TRACE_DIR set.",
+        engine=True,
+    ),
+    Setting(
         name="FISHNET_TPU_TRACE_BUF",
         kind="int",
         default="65536",

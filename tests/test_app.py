@@ -21,6 +21,7 @@ def server():
     s.stop()
 
 
+@pytest.mark.subproc
 def test_app_end_to_end(server, tmp_path):
     # generous per-ply timeout: the chunk deadline is timeout × plies and
     # the pure-python engine needs ~15 s for 3 plies on a busy CI box —

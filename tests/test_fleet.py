@@ -39,7 +39,7 @@ from fishnet_tpu.fleet.member import make_local_member, members_from_specs
 from fishnet_tpu.obs import trace as obs_trace
 from fishnet_tpu.obs.metrics import MetricsRegistry
 
-pytestmark = pytest.mark.faultinject
+pytestmark = [pytest.mark.faultinject, pytest.mark.subproc]
 
 START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 

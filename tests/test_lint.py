@@ -698,6 +698,115 @@ def test_mutated_heartbeat_is_caught(tmp_path):
     assert by_rule(result.findings, "obs-wall-clock")
 
 
+ORPHAN_BAD = '''
+def emit_partial(send, wp, res):
+    send({"t": "partial", "id": 1, "fp": "x", "response": res})  # no ctx
+
+
+def dispatch(send, chunk):
+    send({"t": "go", "id": 1, "chunk": {"positions": chunk}})  # raw dict
+
+
+def to_request(positions):
+    return ServeRequest(kind="analysis", positions=positions)  # no ctx
+'''
+
+ORPHAN_CLEAN = '''
+def emit_partial(send, wp, res):
+    frame = {"t": "partial", "id": 1, "fp": "x", "response": res}
+    if wp.ctx:
+        frame["ctx"] = wp.ctx
+    send(frame)
+
+
+def dispatch(send, chunk):
+    send({"t": "go", "id": 1, "chunk": chunk_to_wire(chunk)})
+
+
+def to_request(positions, ctxs):
+    return ServeRequest(kind="analysis", positions=positions,
+                        position_ctx=ctxs)
+'''
+
+
+def test_orphan_span_flags_every_dropped_hop(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/hop.py": ORPHAN_BAD}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-orphan-span")
+    assert len(found) == 3
+    assert [f.line for f in found] == [3, 7, 11]
+
+
+def test_orphan_span_propagating_hops_are_clean(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/hop.py": ORPHAN_CLEAN}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-orphan-span") == []
+
+
+def test_orphan_span_scope_is_package_only(tmp_path):
+    # the scriptable fixtures in tools/ and tests/ build frames on
+    # purpose — only the package's dispatch sites carry the contract
+    project = make_project(tmp_path, {
+        "tools/hop_hack.py": ORPHAN_BAD,
+        "tests/test_hop.py": ORPHAN_BAD,
+    })
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-orphan-span") == []
+
+
+def test_orphan_span_ignores_positionless_frames(tmp_path):
+    # hb/log/ok/err frames and a chunkless go echo carry no positions —
+    # nothing to orphan
+    src = '''
+def ticker(send):
+    send({"t": "hb", "seq": 1})
+    send({"t": "log", "msg": "x"})
+    send({"t": "ok", "id": 1, "responses": []})
+    send({"t": "go", "positions": 3})
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/hop.py": src}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-orphan-span") == []
+
+
+def test_mutated_partial_frame_is_caught(tmp_path):
+    """Mutation test: strip the ctx forward from the real host's partial
+    frame (the exact careless edit the rule exists for) and assert the
+    lint flags the orphaned hop."""
+    real = (REPO_ROOT / "fishnet_tpu/engine/host.py").read_text()
+    assert 'frame["ctx"] = wp.ctx' in real  # the propagating form ships
+    broken = real.replace(
+        "            if wp.ctx:\n"
+        '                frame["ctx"] = wp.ctx\n', "")
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/host.py": broken}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-orphan-span")
+    assert found and all("partial" in f.message for f in found)
+
+
+def test_mutated_serve_dispatch_is_caught(tmp_path):
+    """Mutation test: drop position_ctx from the real fleet dispatch
+    body builder and assert both ServeRequest sites are flagged."""
+    real = (REPO_ROOT / "fishnet_tpu/fleet/remote.py").read_text()
+    assert real.count("position_ctx=position_ctx,") == 2
+    broken = real.replace("            position_ctx=position_ctx,\n", "")
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/remote.py": broken}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-orphan-span")
+    assert len(found) == 2
+    assert all("position_ctx" in f.message for f in found)
+
+
 # --------------------------------------------------------------------- aot
 
 

@@ -24,7 +24,7 @@ from fishnet_tpu.engine.base import EngineError
 from fishnet_tpu.engine.fakehost import FAKE_CP
 from fishnet_tpu.engine.supervisor import SupervisedEngine
 
-pytestmark = pytest.mark.faultinject
+pytestmark = [pytest.mark.faultinject, pytest.mark.subproc]
 
 START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 

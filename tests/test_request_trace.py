@@ -1,0 +1,529 @@
+"""Request-scoped tracing tests: the context protocol (make_ctx /
+ctx_from_wire / ctx_args), deterministic sampling, the flow-event
+primitive, the in-flight registry, SLO accounting, the serve edge's
+/debug/requests surface, the inflight CLI rendering, and the
+LaneScheduler's per-position lifecycle spans.
+
+The cross-process story (supervisor replay, fleet re-dispatch, the
+merged flight dump) is covered by tools/chaos.py --scenario
+request-trace in CI; this file pins the in-process contracts each hop
+relies on — including the one that matters most: tracing on produces
+bit-identical search results to tracing off.
+"""
+import asyncio
+import contextlib
+import io
+import json
+import socket
+import time
+import types
+
+import pytest
+
+from fishnet_tpu.client.ipc import Chunk, Matrix, PositionResponse, WorkPosition
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit, Score
+from fishnet_tpu.engine.tpu import TpuEngine
+from fishnet_tpu.obs import inflight as obs_inflight
+from fishnet_tpu.obs import trace as obs_trace
+from fishnet_tpu.obs.metrics import MetricsRegistry, SloRecorder
+from fishnet_tpu.serve.server import ServeApp
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+GAME = ["e2e4", "c7c5", "g1f3", "d7d6"]
+
+
+# ------------------------------------------------------- context protocol
+
+
+def test_make_ctx_mints_ids_and_truncates():
+    ctx = obs_trace.make_ctx("t" * 40, "k" * 20, deadline_ms=250)
+    assert set(ctx) == set(obs_trace.CTX_KEYS)
+    assert len(ctx["trace_id"]) == 16
+    assert len(ctx["span_id"]) == 16
+    int(ctx["trace_id"], 16)  # hex
+    assert ctx["tenant"] == "t" * 32
+    assert ctx["kind"] == "k" * 16
+    assert ctx["deadline_ms"] == 250
+    # ids are fresh per stamp
+    assert obs_trace.make_ctx("a", "b")["trace_id"] != ctx["trace_id"]
+
+
+def test_make_ctx_reuses_upstream_trace_id():
+    ctx = obs_trace.make_ctx("t", "analysis", trace_id="feedc0defeedc0de")
+    assert ctx["trace_id"] == "feedc0defeedc0de"
+    assert ctx["span_id"] != ctx["trace_id"]
+
+
+def test_ctx_from_wire_round_trip():
+    ctx = obs_trace.make_ctx("team-a", "bestmove", deadline_ms=900)
+    assert obs_trace.ctx_from_wire(dict(ctx)) == ctx
+    # survives a JSON hop (the pipe / HTTP re-dispatch path)
+    assert obs_trace.ctx_from_wire(json.loads(json.dumps(ctx))) == ctx
+
+
+@pytest.mark.parametrize(
+    "junk",
+    [None, 7, "feedc0de", [], {}, {"trace_id": ""}, {"span_id": "x"}],
+)
+def test_ctx_from_wire_rejects_junk(junk):
+    assert obs_trace.ctx_from_wire(junk) is None
+
+
+def test_ctx_from_wire_truncates_oversized_ids():
+    ctx = obs_trace.ctx_from_wire({"trace_id": "a" * 99, "span_id": "b" * 99})
+    assert ctx["trace_id"] == "a" * 32
+    assert ctx["span_id"] == "b" * 32
+
+
+def test_ctx_args_annotation():
+    ctx = obs_trace.make_ctx("team-a", "analysis")
+    args = obs_trace.ctx_args(ctx, lane=3)
+    assert args == {
+        "trace_id": ctx["trace_id"],
+        "tenant": "team-a",
+        "kind": "analysis",
+        "lane": 3,
+    }
+    # no context degrades to just the extras, never a crash
+    assert obs_trace.ctx_args(None, lane=3) == {"lane": 3}
+
+
+# ------------------------------------------------------------- sampling
+
+
+def test_sampled_rate_bounds(monkeypatch):
+    ids = [obs_trace.new_id() for _ in range(64)]
+    monkeypatch.setenv("FISHNET_TPU_TRACE_SAMPLE", "1.0")
+    assert all(obs_trace.sampled(t) for t in ids)
+    monkeypatch.setenv("FISHNET_TPU_TRACE_SAMPLE", "0.0")
+    assert not any(obs_trace.sampled(t) for t in ids)
+
+
+def test_sampled_mid_rate_is_deterministic(monkeypatch):
+    """The verdict is a pure function of the trace_id — every process
+    that sees the id reaches the same decision with no coordination."""
+    monkeypatch.setenv("FISHNET_TPU_TRACE_SAMPLE", "0.5")
+    ids = [obs_trace.new_id() for _ in range(256)]
+    verdicts = [obs_trace.sampled(t) for t in ids]
+    assert verdicts == [obs_trace.sampled(t) for t in ids]  # stable
+    assert any(verdicts) and not all(verdicts)  # actually samples
+    # junk rates fall back to trace-everything, never crash
+    monkeypatch.setenv("FISHNET_TPU_TRACE_SAMPLE", "not-a-rate")
+    assert obs_trace.sampled(ids[0])
+
+
+# ------------------------------------------------------- flow primitive
+
+
+def test_flow_event_shape():
+    rec = obs_trace.TraceRecorder(capacity=64)
+    rec.flow("request", 12345, "s")
+    rec.flow("request", "feedc0de", "t")
+    rec.flow("request", "feedc0de", "f")
+    s, t, f = rec.snapshot()
+    assert s["ph"] == "s" and s["id"] == "12345"  # ids coerced to str
+    assert t["ph"] == "t" and "bp" not in t
+    # the finish binds to the enclosing slice's END, not the next start
+    assert f["ph"] == "f" and f["bp"] == "e"
+    assert all(e["name"] == "request" for e in (s, t, f))
+    with pytest.raises(ValueError):
+        rec.flow("request", "feedc0de", "x")
+
+
+def test_flow_ids_survive_absorb_shift():
+    """Clock-sync absorb() shifts timestamps; flow ids are strings and
+    must come through untouched or the arrows break at process seams."""
+    child = obs_trace.TraceRecorder(capacity=64)
+    child.flow("request", "feedc0de", "t")
+    parent = obs_trace.TraceRecorder(capacity=64)
+    child_ev = child.snapshot()[0]
+    assert parent.absorb(child.drain(), offset_us=1_000_000.0) == 1
+    merged = parent.snapshot()[0]
+    assert merged["id"] == "feedc0de"
+    assert merged["ts"] == pytest.approx(child_ev["ts"] + 1_000_000.0)
+
+
+# ------------------------------------------------------ inflight registry
+
+
+def test_inflight_lifecycle_and_snapshot():
+    reg = obs_inflight.InflightRegistry()
+    reg.begin("tid-1", "req-1", "team-a", "analysis",
+              deadline_mono_s=time.monotonic() + 5.0, n_positions=2)
+    assert len(reg) == 1
+    reg.stage("tid-1", "admitted")
+    reg.stage("tid-1", "dispatched")
+    # stages are monotone: a replayed position must not rewind the view
+    reg.stage("tid-1", "received")
+    reg.position("tid-1", 0, "lane", lane=3)
+    reg.position("tid-1", 1, "queued")
+    (snap,) = reg.snapshot()
+    assert snap["trace_id"] == "tid-1"
+    assert snap["id"] == "req-1"
+    assert snap["stage"] == "lane"  # position progress bumped the stage
+    assert snap["lanes"] == [3]
+    assert snap["positions"] == {
+        "0": {"stage": "lane", "lane": 3},
+        "1": {"stage": "queued", "lane": None},
+    }
+    assert snap["age_ms"] >= 0.0
+    assert 0.0 < snap["slack_ms"] <= 5000.0
+    json.dumps(snap)  # the /debug/requests payload must be JSON-safe
+    reg.end("tid-1")
+    assert len(reg) == 0 and reg.snapshot() == []
+
+
+def test_inflight_ignores_empty_and_unknown_ids():
+    reg = obs_inflight.InflightRegistry()
+    reg.begin("", "req", "t", "analysis")  # unstamped path: no-op
+    reg.stage("", "admitted")
+    reg.stage("nobody", "admitted")  # client-path ctx nobody begin()s
+    reg.position("nobody", 0, "lane", lane=1)
+    reg.end("nobody")
+    assert len(reg) == 0
+
+
+def test_inflight_snapshot_oldest_first():
+    reg = obs_inflight.InflightRegistry()
+    reg.begin("tid-a", "a", "t", "analysis")
+    reg.begin("tid-b", "b", "t", "analysis")
+    assert [e["trace_id"] for e in reg.snapshot()] == ["tid-a", "tid-b"]
+    # no deadline → slack is unknown, not a crash
+    assert reg.snapshot()[0]["slack_ms"] is None
+
+
+# --------------------------------------------------------- SLO recorder
+
+
+def test_slo_observe_clamps_the_split():
+    """queue ≤ total, device ≤ total − queue, host = the remainder —
+    the three shares can never sum past the latency they explain."""
+    registry = MetricsRegistry()
+    slo = SloRecorder(registry)
+    slo.observe("team-a", "analysis", 100.0, queue_ms=150.0, device_ms=80.0)
+    snap = registry.snapshot()
+    # registry names sanitize the tenant's dash to an underscore
+    assert snap["fishnet_slo_latency_ms_analysis_team_a_sum"] == 100.0
+    assert snap["fishnet_slo_queue_ms_analysis_team_a_sum"] == 100.0
+    assert snap["fishnet_slo_device_ms_analysis_team_a_sum"] == 0.0
+    assert snap["fishnet_slo_host_ms_analysis_team_a_sum"] == 0.0
+    assert snap["fishnet_slo_requests_total_analysis_team_a"] == 1
+    assert "fishnet_slo_deadline_miss_total_analysis_team_a" not in snap
+
+
+def test_slo_counters_and_prometheus_render():
+    registry = MetricsRegistry()
+    slo = SloRecorder(registry)
+    slo.observe("bot", "bestmove", 40.0, queue_ms=10.0, device_ms=25.0,
+                deadline_missed=True)
+    slo.shed("bot", "bestmove")
+    snap = registry.snapshot()
+    assert snap["fishnet_slo_deadline_miss_total_bestmove_bot"] == 1
+    assert snap["fishnet_slo_shed_total_bestmove_bot"] == 1
+    assert snap["fishnet_slo_host_ms_bestmove_bot_sum"] == 5.0
+    text = registry.render_prometheus()
+    assert "fishnet_slo_latency_ms_bestmove_bot_count 1" in text
+    assert 'fishnet_slo_latency_ms_bestmove_bot_bucket{le="+Inf"} 1' in text
+
+
+# ------------------------------------------------- serve edge + registry
+
+
+async def _http(host, port, method, path, obj=None, headers=None):
+    """One-shot HTTP/1.1 client over asyncio streams, with headers."""
+    reader, writer = await asyncio.open_connection(host, port)
+    body = json.dumps(obj).encode("utf-8") if obj is not None else b""
+    head = [
+        f"{method} {path} HTTP/1.1", f"Host: {host}",
+        f"Content-Length: {len(body)}", "Connection: close",
+    ]
+    head.extend(f"{k}: {v}" for k, v in (headers or {}).items())
+    writer.write(("\r\n".join(head) + "\r\n\r\n").encode("latin-1") + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    await writer.wait_closed()
+    head_raw, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head_raw.decode("latin-1").split("\r\n")[0].split()[1])
+    return status, json.loads(payload) if payload else {}
+
+
+def _fake_response(i=0):
+    scores = Matrix()
+    scores.set(1, 2, Score.cp(13))
+    pvs = Matrix()
+    pvs.set(1, 2, ["e2e4"])
+    return PositionResponse(
+        work=None, position_index=i, url=None, scores=scores, pvs=pvs,
+        best_move="e2e4", depth=2, nodes=100, time_s=0.01, nps=10_000,
+    )
+
+
+class GatedSession:
+    """Stub EngineSession parking on a gate so the request stays
+    observable in flight; remembers the ctx each position carried."""
+
+    def __init__(self):
+        self.gate = asyncio.Event()
+        self.seen_ctx = []
+
+    async def submit_many(self, requests):
+        self.seen_ctx = [r.ctx() for r in requests]
+        await asyncio.wait_for(self.gate.wait(), timeout=30.0)
+        return [_fake_response(i) for i in range(len(requests))]
+
+
+@pytest.fixture
+def recorder():
+    rec = obs_trace.install(obs_trace.TraceRecorder(capacity=4096,
+                                                    process_name="test"))
+    try:
+        yield rec
+    finally:
+        obs_trace.uninstall()
+
+
+def _body(tid=""):
+    body = {
+        "id": "req-trace-1",
+        "tenant": "team-a",
+        "positions": [{"fen": START, "moves": ["e2e4"]},
+                      {"fen": START, "moves": []}],
+        "depth": 2,
+        "timeout_ms": 8000,
+    }
+    if tid:
+        body["trace_id"] = tid
+    return body
+
+
+def test_debug_requests_and_edge_spans(recorder):
+    """One traced request through the HTTP edge: /debug/requests shows
+    it mid-flight at its stage, the context reaches the session's
+    PositionRequests, the SLO histograms move, and the ring holds the
+    edge spans + the s/f flow pair under the client's trace_id."""
+    tid = "feedc0defeedc0de"
+
+    async def scenario():
+        registry = MetricsRegistry()
+        session = GatedSession()
+        app = ServeApp(session, max_inflight=4, max_queue=4,
+                       default_timeout_ms=8000, drain_s=5.0,
+                       registry=registry)
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            post = asyncio.ensure_future(
+                _http(host, port, "POST", "/analyse", _body(tid))
+            )
+            seen = None
+            for _ in range(200):
+                _, dbg = await _http(host, port, "GET", "/debug/requests")
+                hits = [e for e in dbg["requests"]
+                        if e["trace_id"] == tid]
+                if hits:
+                    seen = hits[0]
+                    if seen["stage"] == "dispatched":
+                        break
+                await asyncio.sleep(0.02)
+            session.gate.set()
+            status, payload = await asyncio.wait_for(post, timeout=10.0)
+            _, dbg = await _http(host, port, "GET", "/debug/requests")
+            return status, payload, seen, dbg, registry, session
+        finally:
+            await app.drain_and_stop()
+
+    status, payload, seen, dbg, registry, session = asyncio.run(scenario())
+    assert status == 200 and len(payload["results"]) == 2
+    # live introspection caught the request at its dispatch stage
+    assert seen is not None and seen["stage"] == "dispatched"
+    assert seen["tenant"] == "team-a" and seen["n_positions"] == 2
+    assert dbg["inflight"] == 0 and dbg["requests"] == []  # end() ran
+    # the edge context rode next to the work into the session
+    assert [c["trace_id"] for c in session.seen_ctx] == [tid, tid]
+    # SLO accounting keyed by (kind, tenant) observed it
+    snap = registry.snapshot()
+    assert snap["fishnet_slo_requests_total_analysis_team_a"] == 1
+    assert snap["fishnet_slo_device_ms_analysis_team_a_sum"] > 0.0
+    # and the ring carries the waterfall: spans, flow pair, slo instant
+    events = obs_trace.RECORDER.snapshot()
+    mine = [e for e in events if (e.get("args") or {}).get("trace_id") == tid]
+    names = {e["name"] for e in mine}
+    assert {"http.request", "serve.admission", "slo.observe"} <= names
+    http_span = next(e for e in mine if e["name"] == "http.request")
+    assert http_span["ph"] == "X" and http_span["args"]["n"] == 2
+    slo_ev = next(e for e in mine if e["name"] == "slo.observe")
+    assert slo_ev["args"]["total_ms"] >= slo_ev["args"]["queue_ms"]
+    flows = [e for e in events
+             if e["name"] == "request" and e.get("id") == tid]
+    assert {"s", "f"} <= {e["ph"] for e in flows}
+
+
+def test_trace_header_stamps_the_context(recorder):
+    """X-Fishnet-Trace alone (no body field) names the request's id."""
+    tid = "ab1ef1ee7ab1ef1e"
+
+    async def scenario():
+        session = GatedSession()
+        session.gate.set()  # no need to observe mid-flight here
+        app = ServeApp(session, max_inflight=4, max_queue=4,
+                       default_timeout_ms=8000, drain_s=5.0,
+                       registry=MetricsRegistry())
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            return await _http(host, port, "POST", "/analyse", _body(),
+                               headers={"X-Fishnet-Trace": tid})
+        finally:
+            await app.drain_and_stop()
+
+    status, _ = asyncio.run(scenario())
+    assert status == 200
+    events = obs_trace.RECORDER.snapshot()
+    assert any(e["name"] == "http.request"
+               and (e.get("args") or {}).get("trace_id") == tid
+               for e in events)
+
+
+def test_inflight_cli_renders_live_requests():
+    """`fishnet-tpu inflight` against a live serve process: one row per
+    in-flight request with stage and progress columns."""
+    from fishnet_tpu.client.app import run_inflight
+
+    tid = "c0ffeec0ffeec0ff"
+
+    async def scenario():
+        session = GatedSession()
+        app = ServeApp(session, max_inflight=4, max_queue=4,
+                       default_timeout_ms=8000, drain_s=5.0,
+                       registry=MetricsRegistry())
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            post = asyncio.ensure_future(
+                _http(host, port, "POST", "/analyse", _body(tid))
+            )
+            for _ in range(200):
+                _, dbg = await _http(host, port, "GET", "/debug/requests")
+                if any(e["trace_id"] == tid for e in dbg["requests"]):
+                    break
+                await asyncio.sleep(0.02)
+
+            def cli():
+                cfg = types.SimpleNamespace(serve_host=host, serve_port=port)
+                out = io.StringIO()
+                with contextlib.redirect_stdout(out):
+                    rc = run_inflight(cfg)
+                return rc, out.getvalue()
+
+            rc, out = await asyncio.get_running_loop().run_in_executor(
+                None, cli
+            )
+            session.gate.set()
+            await asyncio.wait_for(post, timeout=10.0)
+            return rc, out
+        finally:
+            await app.drain_and_stop()
+
+    rc, out = asyncio.run(scenario())
+    assert rc == 0
+    assert "1 request(s) in flight" in out
+    assert tid in out and "team-a" in out and "dispatched" in out
+
+
+def test_inflight_cli_unreachable_server():
+    from fishnet_tpu.client.app import run_inflight
+
+    with socket.socket() as s:  # a port nothing listens on
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = run_inflight(types.SimpleNamespace(serve_host="127.0.0.1",
+                                                serve_port=port))
+    assert rc == 1
+    assert "cannot reach" in out.getvalue()
+
+
+# ------------------------------------------- LaneScheduler lifecycle spans
+
+
+def _analysis_work(depth=3):
+    return AnalysisWork(
+        id="reqtrace01",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0,
+        depth=depth,
+        multipv=None,
+    )
+
+
+def _make_chunk(n_positions=3, ctx=None):
+    positions = [
+        WorkPosition(work=_analysis_work(), position_index=i, url=None,
+                     skip=False, root_fen=START, moves=GAME[:i],
+                     ctx=dict(ctx) if ctx else None)
+        for i in range(n_positions)
+    ]
+    return Chunk(work=_analysis_work(), deadline=time.monotonic() + 120,
+                 variant="standard", flavor=EngineFlavor.TPU,
+                 positions=positions)
+
+
+def _make_refill_engine():
+    # same shapes as tests/test_refill.py so the jitted programs are
+    # shared in-process; mesh=None pins single-device semantics
+    engine = TpuEngine(refill=True, max_depth=3, tt_size_log2=0,
+                       helper_lanes=1)
+    engine.mesh = None
+    engine.n_dev = 1
+    return engine
+
+
+def test_refill_lifecycle_spans_and_bit_identity(recorder):
+    """A traced chunk through the refill scheduler leaves the full
+    per-position lifecycle on the ring — queued → lane residency →
+    delivered, plus segment.residency windows — all under the request's
+    trace_id; and the traced results are bit-identical to an untraced
+    run of the same chunk."""
+    ctx = obs_trace.make_ctx("team-a", "analysis", deadline_ms=30_000)
+    tid = ctx["trace_id"]
+    traced = asyncio.run(
+        _make_refill_engine().go_multiple(_make_chunk(ctx=ctx))
+    )
+    assert len(traced) == 3
+
+    events = obs_trace.RECORDER.snapshot()
+    mine = [e for e in events if (e.get("args") or {}).get("trace_id") == tid]
+    by_name = {}
+    for e in mine:
+        by_name.setdefault(e["name"], []).append(e)
+    # one queued + one delivered instant per position, indices intact
+    for name in ("position.queued", "position.delivered"):
+        evs = by_name.get(name, [])
+        assert {e["args"]["position_index"] for e in evs} == {0, 1, 2}, name
+        assert all(e["ph"] == "i" for e in evs)
+    # one retroactive lane-residency span per position, real duration
+    lanes = by_name.get("position.lane", [])
+    assert {e["args"]["position_index"] for e in lanes} == {0, 1, 2}
+    assert all(e["ph"] == "X" and e["dur"] >= 0.0 for e in lanes)
+    assert all(e["args"]["error"] is None for e in lanes)
+    # segment residency: which lanes the request occupied per segment
+    residency = by_name.get("segment.residency", [])
+    assert residency, "no segment.residency spans on the ring"
+    assert all("lane" in e["args"] and e["dur"] >= 0.0 for e in residency)
+    # the flow chain threads the scheduler hops under the same id
+    flows = [e for e in events
+             if e["name"] == "request" and e.get("id") == tid]
+    assert len(flows) >= 6  # ≥ queued + delivered per position
+    # nobody begin()'d this ctx here: the engine's registry updates are
+    # harmless no-ops, not phantom entries
+    assert not any(e["trace_id"] == tid
+                   for e in obs_inflight.REGISTRY.snapshot())
+
+    obs_trace.uninstall()
+    plain = asyncio.run(_make_refill_engine().go_multiple(_make_chunk()))
+    for w, g in zip(plain, traced):
+        assert g.position_index == w.position_index
+        assert g.best_move == w.best_move
+        assert g.depth == w.depth
+        assert g.nodes == w.nodes
+        assert g.scores.matrix == w.scores.matrix
+        assert g.pvs.matrix == w.pvs.matrix

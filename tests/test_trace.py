@@ -244,6 +244,7 @@ def make_supervisor(script, extra=(), **kw):
 
 
 @pytest.mark.faultinject
+@pytest.mark.subproc
 def test_skewed_child_clock_lands_on_parent_timeline(tmp_path, monkeypatch):
     """fakehost --trace-skew 123 reports a monotonic clock 123 s behind
     the real one in its mono fields AND stamps its streamed trace events
@@ -281,6 +282,7 @@ def test_skewed_child_clock_lands_on_parent_timeline(tmp_path, monkeypatch):
 
 
 @pytest.mark.faultinject
+@pytest.mark.subproc
 def test_child_death_flight_dump(tmp_path, monkeypatch):
     """A crashed child must leave a loadable merged flight dump: the
     supervisor's recovery ladder writes trace-child-death-*.json into
@@ -320,6 +322,7 @@ def test_child_death_flight_dump(tmp_path, monkeypatch):
 
 
 @pytest.mark.faultinject
+@pytest.mark.subproc
 def test_tracing_off_no_dump_no_recorder(tmp_path, monkeypatch):
     """Default path: FISHNET_TPU_TRACE_DIR unset — no recorder is
     installed, a crash writes nothing, and the run still recovers."""

@@ -61,6 +61,14 @@ _BY_TYPE = {
     "bool": (True, False),
     "Optional[int]": (2, 5),
     "Optional[str]": ("u1", "u2"),
+    # request context (obs/trace.py CTX_KEYS): the wire reader
+    # normalizes through ctx_from_wire, so factories carry all keys
+    "Optional[dict]": (
+        {"trace_id": "aa" * 8, "span_id": "bb" * 8, "tenant": "t1",
+         "kind": "analysis", "deadline_ms": None},
+        {"trace_id": "cc" * 8, "span_id": "dd" * 8, "tenant": "t2",
+         "kind": "bestmove", "deadline_ms": 500},
+    ),
     "List[str]": (["e2e4"], ["d2d4", "g8f6"]),
     "NodeLimit": (NodeLimit(4000, 8000), NodeLimit(1000, 2000)),
     "Optional[Clock]": (Clock(600, 600, 2), Clock(300, 300, 0)),

@@ -30,6 +30,17 @@ is harvested, not re-searched), exactly one loss event must be
 recorded, and the merged flight-recorder dump must carry spans from all
 three member processes on one clock-synced timeline despite their
 deliberately skewed clocks.
+
+`--scenario request-trace` is the request-tracing acceptance gate
+(ISSUE 14): a request POSTed to /analyse on a ServeApp fronting that
+same 3-member dying fleet must leave ONE merged Chrome trace linking
+the HTTP edge through admission, chunk dispatch, the member loss and
+the re-dispatch into the surviving member's process; /debug/requests
+must show the request's stage while it is in flight; and the results
+must be bit-identical with tracing off. The ladder's kill-mid-chunk
+(--trace-smoke) and fleet-member-loss runs additionally stamp their
+chunks with a request context and assert the id survives supervisor
+respawn replay and fleet re-dispatch in the merged dumps.
 """
 from __future__ import annotations
 
@@ -58,22 +69,32 @@ from fishnet_tpu.client.wire import (  # noqa: E402
 from fishnet_tpu.engine.base import EngineError  # noqa: E402
 from fishnet_tpu.engine.fakehost import FAKE_CP, NAMED_SCRIPTS  # noqa: E402
 from fishnet_tpu.engine.supervisor import SupervisedEngine  # noqa: E402
+from fishnet_tpu.obs import trace as obs_trace  # noqa: E402
 
 START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
 
 
-def make_chunk(index: int, ttl: float, n_positions: int) -> Chunk:
+def make_chunk(index: int, ttl: float, n_positions: int,
+               trace_id: str = "") -> Chunk:
     work = AnalysisWork(
         id=f"chaos{index:03d}",
         nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
         timeout_s=ttl, depth=1, multipv=None,
     )
+    # a trace_id stamps every position with a request context, so the
+    # continuity scenarios can follow one request id through respawn
+    # replay and fleet re-dispatch
+    ctx = (obs_trace.make_ctx("chaos", "analysis",
+                              deadline_ms=int(ttl * 1000),
+                              trace_id=trace_id)
+           if trace_id else None)
     return Chunk(
         work=work, deadline=time.monotonic() + ttl, variant="standard",
         flavor=EngineFlavor.TPU,
         positions=[
             WorkPosition(work=work, position_index=i, url=None, skip=False,
-                         root_fen=START, moves=[])
+                         root_fen=START, moves=[],
+                         ctx=dict(ctx) if ctx else None)
             for i in range(n_positions)
         ],
     )
@@ -297,11 +318,14 @@ async def fleet_scenario(args) -> int:
 
     from fishnet_tpu.fleet import FleetCoordinator
     from fishnet_tpu.fleet.member import make_local_member
-    from fishnet_tpu.obs import trace as obs_trace
     from tools import trace_report
 
     problems = []
     n = 6
+    # fixed request id every position carries: the continuity checks
+    # follow it from the dispatch spans through the member loss into the
+    # survivor's re-dispatched search
+    tid = "ab1ef1ee7ab1ef1ee7ab1ef1"
     with tempfile.TemporaryDirectory(prefix="chaos-fleet-") as tmp:
         trace_dir = f"{tmp}/traces"
         # set before any member constructs: SupervisedEngine.__init__
@@ -339,7 +363,9 @@ async def fleet_scenario(args) -> int:
         t0_us = obs_trace.now_us()
         try:
             await coord.start()
-            responses = await coord.go_multiple(make_chunk(1, 30.0, n))
+            responses = await coord.go_multiple(
+                make_chunk(1, 30.0, n, trace_id=tid)
+            )
             _check_exactly_once(responses, n, problems, "fleet-member-loss")
             if any(r.scores.best().value != FAKE_CP for r in responses):
                 problems.append(
@@ -434,6 +460,26 @@ async def fleet_scenario(args) -> int:
                         f"fleet-member-loss: merged dump is missing the "
                         f"coordinator's {expected!r} marker"
                     )
+            # ctx continuity: the request id stamped on the chunk must
+            # ride the loss into the re-dispatched sub-chunk — the loss
+            # instant names it, and a FOURTH fake.search span (3 initial
+            # dispatches + the survivor's re-dispatch) carries it
+            req = trace_report.request_events(events, tid)
+            req_names = {e.get("name") for e in req}
+            if "fleet.member-loss" not in req_names:
+                problems.append(
+                    "fleet-member-loss: the loss instant does not name "
+                    "the request's trace id — re-dispatch dropped ctx"
+                )
+            searches_tid = [
+                e for e in req if e.get("name") == "fake.search"
+            ]
+            if len(searches_tid) < 4:
+                problems.append(
+                    "fleet-member-loss: expected the re-dispatched "
+                    "sub-chunk to add a fourth fake.search span carrying "
+                    f"the request id, got {len(searches_tid)}"
+                )
 
     print()
     for msg in problems:
@@ -456,10 +502,12 @@ async def trace_smoke(args) -> int:
     does not parse."""
     import os
 
-    from fishnet_tpu.obs import trace as obs_trace
     from tools import trace_report
 
     problems = []
+    # fixed request id: the continuity checks follow it across the kill
+    # into the respawned incarnation's replay
+    tid = "c0ffeec0ffeec0ffeec0ffee"
     with tempfile.TemporaryDirectory(prefix="chaos-trace-") as tmp:
         trace_dir = f"{tmp}/traces"
         # set before the supervisor constructs: its __init__ reads the
@@ -474,12 +522,20 @@ async def trace_smoke(args) -> int:
         # child trace ring, so the dump exercises the cross-process merge
         sup.host_cmd += ["--trace-skew", "0.0"]
         try:
-            responses = await sup.go_multiple(make_chunk(1, 30.0, 4))
+            responses = await sup.go_multiple(
+                make_chunk(1, 30.0, 4, trace_id=tid)
+            )
             _check_exactly_once(responses, 4, problems, "trace-smoke")
         except EngineError as e:
             problems.append(f"trace-smoke: chunk failed outright: {e}")
         finally:
             print_stats(sup.stats)
+            rec = obs_trace.RECORDER
+            if rec is not None:
+                # second dump AFTER recovery: the child-death dump above
+                # is written mid-replay, this one holds the respawned
+                # incarnation's spans for the ctx-continuity checks
+                rec.flight_dump(trace_dir, "smoke-final")
             await sup.close()
         obs_trace.uninstall()
         del os.environ["FISHNET_TPU_TRACE_DIR"]
@@ -514,6 +570,41 @@ async def trace_smoke(args) -> int:
                             "timelines did not both land"
                         )
 
+        # ctx continuity (kill-mid-chunk): in the post-recovery dump the
+        # request id must link the journaled pre-death partials to the
+        # respawned incarnation's replay — the chain spans BOTH host
+        # incarnations (two child pids) plus the supervisor's flow hops
+        finals = sorted(Path(trace_dir).glob("trace-smoke-final-*.json"))
+        if not finals:
+            problems.append(
+                f"trace-smoke: no post-recovery dump in {trace_dir}"
+            )
+        else:
+            events = trace_report.load_events(str(finals[-1]))
+            req = trace_report.request_events(events, tid)
+            req_names = {e.get("name") for e in req}
+            if "position.journaled" not in req_names:
+                problems.append(
+                    "trace-smoke: no position.journaled instant carries "
+                    "the request id — the journal dropped ctx across "
+                    "the kill"
+                )
+            search_pids = {e.get("pid") for e in req
+                           if e.get("name") == "fake.search"}
+            if len(search_pids) < 2:
+                problems.append(
+                    "trace-smoke: the request chain does not span both "
+                    "host incarnations (fake.search pids: "
+                    f"{sorted(search_pids)}) — replay lost the context"
+                )
+            flow_pids = {e.get("pid") for e in req
+                         if e.get("ph") in ("s", "t", "f")}
+            if len(flow_pids) < 2:
+                problems.append(
+                    "trace-smoke: request flow hops come from fewer "
+                    "than two processes — the cross-process link is gone"
+                )
+
     print()
     for msg in problems:
         if args.format == "github":
@@ -523,6 +614,249 @@ async def trace_smoke(args) -> int:
     if problems:
         return 1
     print("chaos trace smoke: flight dump written, merged, and parsed")
+    return 0
+
+
+async def request_trace_scenario(args) -> int:
+    """Request-tracing acceptance gate (ISSUE 14). One request POSTed
+    to /analyse on a ServeApp fronting a 3-member fakehost fleet, with
+    member m0 killed mid-chunk, must leave ONE merged Chrome trace whose
+    spans link the HTTP edge to every process that touched the request —
+    including the survivor that absorbed the re-dispatch — while
+    `GET /debug/requests` shows the request's stage in flight; and the
+    search results must be bit-identical with tracing on vs off."""
+    import os
+
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs import metrics as obs_metrics
+    from fishnet_tpu.serve.server import ServeApp
+    from tools import trace_report
+
+    problems = []
+    # fixed request id so the traced and untraced phases submit
+    # byte-identical bodies
+    tid = "feedc0defeedc0defeedc0defeedc0de"
+
+    async def http(host, port, method, path, body=None):
+        """One HTTP/1.1 exchange over a raw asyncio connection (the
+        serve front-end speaks plain stdlib HTTP; no client library)."""
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            payload = (b"" if body is None
+                       else json.dumps(body).encode("utf-8"))
+            head = (
+                f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + payload)
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        header, _, body_bytes = raw.partition(b"\r\n\r\n")
+        status = int(header.split(None, 2)[1])
+        return status, (json.loads(body_bytes) if body_bytes else {})
+
+    async def run_once(tmp: str, tag: str):
+        """One POST /analyse against a fresh 3-member fleet behind the
+        serve front-end; m0 dies after acking 1 position. Polls
+        /debug/requests while the request is in flight. Returns
+        (status, payload, stages_seen, coordinator)."""
+
+        def member(name, script, skew):
+            return make_local_member(
+                name,
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", json.dumps(script),
+                    "--state", f"{tmp}/{tag}-{name}.json",
+                    "--hb-interval", "0.05",
+                    "--trace-skew", str(skew),
+                    # widen the in-flight window so the /debug/requests
+                    # poll reliably catches the request mid-stage
+                    "--latency-ms", "250",
+                ],
+                logger=Logger(verbose=0),
+                hb_interval=0.05,
+                hb_timeout=1.0,
+                backoff=RandomizedBackoff(max_s=0.05),
+            )
+
+        members = [
+            member("m0", {"chunks": ["die-after:1", "ok"]}, 5.0),
+            member("m1", {"chunks": ["ok"]}, 0.0),
+            member("m2", {"chunks": ["ok"]}, 2.5),
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=obs_metrics.MetricsRegistry(),
+            redispatch_max=3, loss_window=0.2,
+        )
+        app = ServeApp(
+            EngineSession(coord, flavor=EngineFlavor.TPU),
+            logger=Logger(verbose=0),
+            registry=obs_metrics.MetricsRegistry(),
+        )
+        stages = []
+        try:
+            await coord.start()
+            host, port = await app.start("127.0.0.1", 0)
+            body = {
+                "id": f"reqtrace-{tag}",
+                "tenant": "chaos",
+                "trace_id": tid,
+                # distinct move chains → distinct position fingerprints,
+                # so the exactly-once ledger tracks 6 real entries
+                "positions": [
+                    {"fen": START, "moves": ["e2e4"] * i}
+                    for i in range(6)
+                ],
+                "depth": 1,
+                "timeout_ms": 8000,
+            }
+            post = asyncio.ensure_future(
+                http(host, port, "POST", "/analyse", body)
+            )
+            poll_deadline = time.monotonic() + 30.0
+            while not post.done() and time.monotonic() < poll_deadline:
+                st, dbg = await http(host, port, "GET", "/debug/requests")
+                if st == 200:
+                    for r in dbg.get("requests", []):
+                        if r.get("trace_id") == tid:
+                            stages.append(r.get("stage"))
+                await asyncio.sleep(0.02)
+            status, payload = await asyncio.wait_for(post, timeout=30.0)
+        finally:
+            await app.drain_and_stop()
+            await coord.close()
+        return status, payload, stages, coord
+
+    with tempfile.TemporaryDirectory(prefix="chaos-reqtrace-") as tmp:
+        trace_dir = f"{tmp}/traces"
+        # set before any member constructs: SupervisedEngine.__init__
+        # reads the registry and installs the process-global recorder
+        os.environ["FISHNET_TPU_TRACE_DIR"] = trace_dir
+        print("== request-trace: tracing ON, m0 dies after 1 ack ==")
+        try:
+            status, payload, stages, coord = await run_once(tmp, "on")
+        finally:
+            rec = obs_trace.RECORDER
+            if rec is not None:
+                rec.flight_dump(trace_dir, "request-trace")
+            obs_trace.uninstall()
+            del os.environ["FISHNET_TPU_TRACE_DIR"]
+
+        if status != 200:
+            problems.append(
+                f"request-trace: POST /analyse answered {status}: {payload}"
+            )
+        if coord.stats.losses != 1:
+            problems.append(
+                "request-trace: expected exactly one member loss, got "
+                f"{coord.stats.losses}"
+            )
+        results = payload.get("results", [])
+        if len(results) != 6:
+            problems.append(
+                f"request-trace: {len(results)} results for 6 positions"
+            )
+        if not stages:
+            problems.append(
+                "request-trace: /debug/requests never showed the request "
+                "while it was in flight"
+            )
+        elif "dispatched" not in stages:
+            problems.append(
+                "request-trace: /debug/requests never showed the "
+                f"'dispatched' stage (saw {sorted(set(stages))})"
+            )
+
+        dumps = sorted(Path(trace_dir).glob("trace-request-trace-*.json"))
+        if not dumps:
+            problems.append(
+                f"request-trace: no merged flight dump in {trace_dir}"
+            )
+        else:
+            print(f"\nmerged dump: {dumps[-1].name}")
+            events = trace_report.load_events(str(dumps[-1]))
+            req = trace_report.request_events(events, tid)
+            names = {e.get("name") for e in req}
+            # the full causal chain, HTTP edge → lane-level hand-offs:
+            # each name is one hop that must carry the request id
+            for expected in ("http.request", "serve.admission",
+                             "serve.chunk", "fleet.dispatch",
+                             "supervisor.dispatch", "position.journaled",
+                             "slo.observe", "fake.search"):
+                if expected not in names:
+                    problems.append(
+                        "request-trace: the request's causal chain is "
+                        f"missing {expected!r} in the merged dump"
+                    )
+            flow_pids = {e.get("pid") for e in req
+                         if e.get("ph") in ("s", "t", "f")}
+            if len(flow_pids) < 3:
+                problems.append(
+                    "request-trace: request flow hops span "
+                    f"{len(flow_pids)} process(es), expected the serve "
+                    "process plus at least two member children"
+                )
+            searches = [e for e in req if e.get("name") == "fake.search"]
+            if len(searches) < 4:
+                problems.append(
+                    "request-trace: expected the re-dispatch to add a "
+                    "fourth fake.search span carrying the request id, "
+                    f"got {len(searches)}"
+                )
+            if "fleet.member-loss" not in names:
+                problems.append(
+                    "request-trace: the member-loss instant does not "
+                    "name the request's trace id"
+                )
+            wf = trace_report.request_waterfall(events, tid)
+            if wf is None:
+                problems.append(
+                    "request-trace: request_waterfall found nothing for "
+                    "the request id"
+                )
+            else:
+                print(trace_report.render_waterfall(wf))
+                problems.extend(
+                    f"request-trace: {p}"
+                    for p in trace_report.request_crosscheck(wf)
+                )
+
+        # ---- tracing OFF: same fault schedule, results must not move
+        print("\n== request-trace: tracing OFF, same fault schedule ==")
+        status_off, payload_off, _stages, _coord = await run_once(tmp, "off")
+        if status_off != 200:
+            problems.append(
+                "request-trace: untraced POST /analyse answered "
+                f"{status_off}: {payload_off}"
+            )
+        elif payload.get("results") != payload_off.get("results"):
+            problems.append(
+                "request-trace: search results differ with tracing on "
+                "vs off — instrumentation perturbed the search"
+            )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos request trace::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos request trace: one merged edge-to-member timeline, live "
+          "stage introspection, results identical with tracing off")
     return 0
 
 
@@ -549,12 +883,15 @@ def main(argv=None) -> int:
     p.add_argument("--breaker-threshold", type=int, default=3)
     p.add_argument("--probe-interval", type=float, default=5.0)
     p.add_argument("--scenario", nargs="?", const="ladder", default=None,
-                   choices=["ladder", "fleet-member-loss"],
+                   choices=["ladder", "fleet-member-loss", "request-trace"],
                    help="run an acceptance scenario and exit non-zero on "
                         "any delivery violation: `ladder` (default when "
                         "the flag is bare) is the session-recovery "
                         "ladder, `fleet-member-loss` kills one of 3 "
-                        "fleet members mid-chunk")
+                        "fleet members mid-chunk, `request-trace` POSTs "
+                        "a traced request to /analyse over that same "
+                        "dying fleet and checks the merged edge-to-"
+                        "member timeline")
     p.add_argument("--trace-smoke", action="store_true",
                    help="kill a child mid-chunk with tracing on and "
                         "verify the merged flight dump parses")
@@ -569,6 +906,8 @@ def main(argv=None) -> int:
         return asyncio.run(scenario(args))
     if args.scenario == "fleet-member-loss":
         return asyncio.run(fleet_scenario(args))
+    if args.scenario == "request-trace":
+        return asyncio.run(request_trace_scenario(args))
     if args.trace_smoke:
         return asyncio.run(trace_smoke(args))
     return asyncio.run(replay(args))

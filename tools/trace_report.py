@@ -21,10 +21,20 @@ exact numbers — so `aggregate(args)` and `aggregate(child spans)` must
 agree to well under 1%; `--selftest` (and tests/test_trace.py) assert
 that.
 
+Request waterfalls: `--request <trace_id>` reconstructs one request's
+causal chain from its span links — every span/instant whose args carry
+the trace_id (directly or in a dispatch span's `trace_ids` list) plus
+the `request` flow hops tying the processes together — and renders it
+as a start-ordered waterfall. When the dump holds the serve edge's
+`slo.observe` instant for that request, the reconstructed end-to-end
+time is cross-checked within 1% against the latency the SLO histogram
+actually recorded (same idiom as the SyncStats/segment check).
+
 Usage:
   python tools/trace_report.py TRACE.json
   python tools/trace_report.py TRACE.json --format=github   # CI step
   python tools/trace_report.py TRACE.json --json
+  python tools/trace_report.py TRACE.json --request aabbccdd11223344
 """
 from __future__ import annotations
 
@@ -149,6 +159,121 @@ def summarize(events: List[dict]) -> dict:
     }
 
 
+def request_events(events: List[dict], trace_id: str) -> List[dict]:
+    """Every event on one request's causal chain: spans/instants whose
+    args carry the trace_id (their own or in a dispatch span's
+    `trace_ids` list) and the `request` flow hops with that id."""
+    out = []
+    for e in events:
+        args = e.get("args") or {}
+        if args.get("trace_id") == trace_id:
+            out.append(e)
+            continue
+        tids = args.get("trace_ids")
+        if isinstance(tids, list) and trace_id in tids:
+            out.append(e)
+            continue
+        if e.get("ph") in ("s", "t", "f") and str(e.get("id")) == trace_id:
+            out.append(e)
+    return out
+
+
+def request_waterfall(events: List[dict], trace_id: str) -> Optional[dict]:
+    """One request's start-ordered waterfall, or None if the dump holds
+    nothing for that id."""
+    evs = request_events(events, trace_id)
+    if not evs:
+        return None
+    spans = [e for e in evs if e.get("ph") == "X"]
+    instants = [e for e in evs if e.get("ph") == "i"]
+    flows = [e for e in evs if e.get("ph") in ("s", "t", "f")]
+    t0 = min(float(e.get("ts", 0.0)) for e in evs)
+    rows = []
+    for e in sorted(spans + instants,
+                    key=lambda e: float(e.get("ts", 0.0))):
+        rows.append({
+            "name": str(e.get("name")),
+            "pid": e.get("pid"),
+            "start_ms": round((float(e.get("ts", 0.0)) - t0) / 1000.0, 3),
+            "dur_ms": round(float(e.get("dur", 0.0)) / 1000.0, 3)
+            if e.get("ph") == "X" else None,
+            "args": {
+                k: v for k, v in (e.get("args") or {}).items()
+                if k not in ("trace_id", "trace_ids")
+            },
+        })
+    http = [e for e in spans if e.get("name") == "http.request"]
+    slo = [e for e in instants if e.get("name") == "slo.observe"]
+    http_ms = (
+        max(float(e.get("dur", 0.0)) for e in http) / 1000.0
+        if http else None
+    )
+    slo_ms = (
+        float((slo[0].get("args") or {}).get("total_ms", 0.0))
+        if slo else None
+    )
+    last = max(
+        float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+        for e in spans + instants
+    )
+    return {
+        "request": trace_id,
+        "events": len(evs),
+        "flow_hops": len(flows),
+        "processes": sorted({e.get("pid") for e in evs}),
+        "span_total_ms": round((last - t0) / 1000.0, 3),
+        "http_ms": round(http_ms, 3) if http_ms is not None else None,
+        "slo_total_ms": round(slo_ms, 3) if slo_ms is not None else None,
+        "rows": rows,
+    }
+
+
+def request_crosscheck(wf: dict, tolerance: float = 0.01) -> List[str]:
+    """The <=1% agreement contract between the reconstructed waterfall
+    and the SLO histogram observation the serve edge recorded for this
+    request. Silently passes when the dump lacks either side (a
+    client-chunk trace has no serve edge)."""
+    http_ms, slo_ms = wf.get("http_ms"), wf.get("slo_total_ms")
+    if http_ms is None or slo_ms is None:
+        return []
+    ref = max(abs(slo_ms), 1e-9)
+    if abs(http_ms - slo_ms) / ref > tolerance:
+        return [
+            f"request {wf['request']}: http.request span is "
+            f"{http_ms:.3f}ms but the SLO histogram observed "
+            f"{slo_ms:.3f}ms (>{tolerance:.0%} apart)"
+        ]
+    return []
+
+
+def render_waterfall(wf: dict) -> str:
+    procs = ", ".join(str(p) for p in wf["processes"])
+    lines = [
+        f"request {wf['request']}: {wf['events']} events across "
+        f"{len(wf['processes'])} process(es) [{procs}], "
+        f"{wf['flow_hops']} flow hops, "
+        f"{wf['span_total_ms']:.3f}ms end to end",
+        "",
+        f"{'start_ms':>10} {'dur_ms':>10}  {'pid':>7}  name",
+    ]
+    for row in wf["rows"]:
+        dur = f"{row['dur_ms']:>10.3f}" if row["dur_ms"] is not None \
+            else f"{'·':>10}"
+        lines.append(
+            f"{row['start_ms']:>10.3f} {dur}  {row['pid']!s:>7}  "
+            f"{row['name']}"
+        )
+    if wf["slo_total_ms"] is not None:
+        lines += [
+            "",
+            f"slo observation: {wf['slo_total_ms']:.3f}ms total "
+            f"(http span {wf['http_ms']:.3f}ms)"
+            if wf["http_ms"] is not None else
+            f"slo observation: {wf['slo_total_ms']:.3f}ms total",
+        ]
+    return "\n".join(lines)
+
+
 def crosscheck(report: dict, tolerance: float = 0.01) -> List[str]:
     """The <=1% agreement contract between SyncStats args and the child
     spans rendered from them. Returns human-readable violations."""
@@ -210,6 +335,12 @@ def main(argv=None) -> int:
         help="fail unless SyncStats args and segment child spans agree "
              "within 1%% (the dump's internal cross-validation)",
     )
+    parser.add_argument(
+        "--request", metavar="TRACE_ID", default=None,
+        help="render one request's waterfall from its span links and "
+             "cross-check it within 1%% against the serve latency "
+             "histogram observation for that request",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -221,6 +352,35 @@ def main(argv=None) -> int:
         else:
             print(f"trace-report: {msg}", file=sys.stderr)
         return 2
+
+    if args.request is not None:
+        wf = request_waterfall(events, args.request)
+        if wf is None:
+            msg = f"no events for request {args.request} in {args.trace}"
+            if args.format == "github":
+                print(f"::error title=trace-report::{msg}")
+            else:
+                print(f"trace-report: {msg}", file=sys.stderr)
+            return 2
+        violations = request_crosscheck(wf)
+        if args.json:
+            print(json.dumps(wf, indent=2))
+        else:
+            if args.format == "github":
+                print(
+                    f"::notice title=trace-report request::"
+                    f"{wf['request']}: {wf['events']} events, "
+                    f"{len(wf['processes'])} processes, "
+                    f"{wf['span_total_ms']:.3f}ms end to end"
+                )
+            print(render_waterfall(wf))
+        for msg in violations:
+            if args.format == "github":
+                print(f"::error title=trace-report crosscheck::{msg}")
+            else:
+                print(f"trace-report: CROSSCHECK FAILED: {msg}",
+                      file=sys.stderr)
+        return 1 if violations else 0
 
     report = summarize(events)
     violations = crosscheck(report) if args.selftest else []
