@@ -1031,6 +1031,112 @@ def run_fleet_stage(timeout: float) -> dict | None:
     }
 
 
+def run_fleet_tail_stage(timeout: float) -> dict | None:
+    """Fleet tail-latency row (ISSUE 15): 3 fakehost members, one a
+    deliberate straggler, the same chunk stream run with hedged
+    dispatch off and on. Hedging duplicates the straggler's unfinished
+    positions to a free member once deadline slack runs low
+    (first-answer-wins through the exactly-once ledger), so the row
+    reports per-chunk p50/p99 latency plus the loss and hedge counters
+    for both modes — the p99 delta is the feature. CPU-only, no JAX.
+
+    Knobs: BENCH_FLEET_TAIL=0 skips; BENCH_FLEET_TAIL_CHUNKS rounds
+    (default 12); BENCH_FLEET_TAIL_LATENCY_MS straggler latency
+    (default 200)."""
+    import asyncio
+
+    from fishnet_tpu.client.backoff import RandomizedBackoff
+    from fishnet_tpu.client.ipc import Chunk, WorkPosition
+    from fishnet_tpu.client.logger import Logger
+    from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+
+    rounds = int(os.environ.get("BENCH_FLEET_TAIL_CHUNKS", "12"))
+    straggle_ms = float(os.environ.get("BENCH_FLEET_TAIL_LATENCY_MS", "200"))
+    start_fen = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+    ttl = 2.0
+
+    def one_chunk(i: int, chunk_ttl: float) -> Chunk:
+        work = AnalysisWork(
+            id=f"fleettail{i:04d}",
+            nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+            timeout_s=chunk_ttl, depth=1, multipv=None,
+        )
+        return Chunk(
+            work=work, deadline=time.monotonic() + chunk_ttl,
+            variant="standard", flavor=EngineFlavor.TPU,
+            positions=[WorkPosition(
+                work=work, position_index=p, url=None, skip=False,
+                root_fen=start_fen, moves=[])
+                for p in range(3)],
+        )
+
+    async def measure(hedge: bool) -> dict:
+        members = [
+            make_local_member(
+                name,
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", '{"chunks": ["ok"]}',
+                    "--hb-interval", "0.05",
+                    "--latency-ms", str(ms),
+                ],
+                logger=Logger(verbose=0),
+                hb_interval=0.05, hb_timeout=2.0,
+                backoff=RandomizedBackoff(max_s=0.1),
+            )
+            for name, ms in (
+                ("straggler", straggle_ms), ("fast0", 0), ("fast1", 0),
+            )
+        ]
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(), loss_window=5.0,
+            # fire the hedge halfway into the straggler's service time,
+            # well before the deadline — the hedge must be able to win
+            hedge=hedge, hedge_slack_ms=int(ttl * 1000 - straggle_ms / 2),
+        )
+        lat = []
+        try:
+            await coord.start()
+            # warm round outside the timing (ttl far past the trigger)
+            await coord.go_multiple(one_chunk(9_000, 30.0))
+            for i in range(rounds):
+                t0 = time.monotonic()
+                await coord.go_multiple(one_chunk(i, ttl))
+                lat.append(time.monotonic() - t0)
+        finally:
+            await coord.close()
+        lat.sort()
+        return {
+            "p50_ms": round(lat[len(lat) // 2] * 1000, 1),
+            "p99_ms": round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))] * 1000, 1),
+            "losses": coord.stats.losses,
+            "hedges": coord.stats.hedges,
+            "hedge_wins": coord.stats.hedge_wins,
+        }
+
+    rows = {}
+    for mode, hedge in (("hedge_off", False), ("hedge_on", True)):
+        try:
+            rows[mode] = asyncio.run(
+                asyncio.wait_for(measure(hedge),
+                                 timeout=min(timeout, 120.0)))
+        except (Exception, asyncio.TimeoutError) as e:
+            print(f"bench fleet_tail: {mode} run failed: {e}",
+                  file=sys.stderr, flush=True)
+            return None
+    return {
+        "members": 3,
+        "straggler_latency_ms": straggle_ms,
+        "chunks": rounds,
+        **rows,
+    }
+
+
 def run_coldstart_stage(timeout: float) -> dict | None:
     """Cold-start A/B row (AOT program assets, fishnet_tpu/aot/):
     time-to-first-result of a FRESH engine process, plain JIT vs booted
@@ -1354,6 +1460,23 @@ def main() -> None:
             res = run_fleet_stage(min(stage_timeout, remaining))
             matrix["fleet_scaling"] = res
             print("bench config fleet_scaling: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # fleet tail row (ISSUE 15): the same 3-member fleet with one
+    # straggler, hedge off vs on — the p99 delta is the hedged-dispatch
+    # feature, next to fleet_scaling's throughput story
+    if os.environ.get("BENCH_FLEET_TAIL",
+                      os.environ.get("BENCH_FLEET", "1")) != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 60.0:
+            print("bench: skipping fleet_tail (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["fleet_tail"] = None
+        else:
+            res = run_fleet_tail_stage(min(stage_timeout, remaining))
+            matrix["fleet_tail"] = res
+            print("bench config fleet_tail: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
 

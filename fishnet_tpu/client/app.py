@@ -95,6 +95,10 @@ def make_engine_factory(cfg: Config, logger: Logger, stats=None):
                         logger=logger,
                     ),
                     logger=logger,
+                    # runtime membership (POST /fleet/members, fleet-ctl):
+                    # an added 'local' member builds through the same
+                    # Config-closed factory as the boot-time ones
+                    local_factory=local_factory,
                 )
             return tpu_engine
         if flavor is EngineFlavor.TPU:
@@ -422,6 +426,79 @@ def run_inflight(cfg: Config) -> int:
     return 0
 
 
+def run_fleet_ctl(cfg: Config) -> int:
+    """`fishnet-tpu fleet-ctl [list | add SPEC | drain NAME | remove
+    NAME]`: runtime membership against a running fleet front-end's
+    /fleet/members admin surface (--serve-host/--serve-port pick the
+    target). `drain` + `remove` + `add` is a zero-loss rolling restart
+    (docs/fleet.md)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    host = cfg.serve_host or settings.get_str("FISHNET_TPU_SERVE_HOST")
+    port = (
+        cfg.serve_port if cfg.serve_port is not None
+        else settings.get_int("FISHNET_TPU_SERVE_PORT")
+    )
+    url = f"http://{host}:{port}/fleet/members"
+    sub = list(cfg.extra_args) or ["list"]
+    action, operand = sub[0], (sub[1] if len(sub) > 1 else None)
+    if action in ("add", "drain", "remove") and operand is None:
+        print(f"fleet-ctl: {action} needs an argument "
+              "(add SPEC / drain NAME / remove NAME)")
+        return 2
+    try:
+        if action == "list":
+            req = urllib.request.Request(url, method="GET")
+        elif action in ("add", "drain", "remove"):
+            body = {"action": action}
+            body["spec" if action == "add" else "member"] = operand
+            req = urllib.request.Request(
+                url, method="POST", data=json.dumps(body).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+            )
+        else:
+            print(f"fleet-ctl: unknown action {action!r} "
+                  "(use list / add / drain / remove)")
+            return 2
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            payload = json.loads(r.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        try:
+            detail = json.loads(e.read().decode("utf-8")).get("error", "")
+        except (ValueError, OSError):
+            detail = ""
+        print(f"fleet-ctl: {url} answered HTTP {e.code}: {detail}")
+        return 1
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"fleet-ctl: cannot reach {url}: {e}")
+        return 1
+    if action != "list":
+        print(json.dumps(payload, indent=2))
+        return 0
+    members = payload.get("members") or []
+    print(
+        f"{len(members)} member(s), {payload.get('members_live', 0)} "
+        f"live; losses={payload.get('losses', 0)} "
+        f"readmissions={payload.get('readmissions', 0)} "
+        f"hedges={payload.get('hedges', 0)}"
+    )
+    cols = ("name", "kind", "state", "backlog", "inflight", "losses",
+            "cooldown_s")
+    rows = [
+        tuple(str(m.get(c, "")) for c in cols) for m in members
+    ]
+    widths = [
+        max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+        for i, c in enumerate(cols)
+    ]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return 0
+
+
 def main(argv=None) -> int:
     from .configure import parse_and_configure
     from .systemd import system_unit, user_unit
@@ -453,6 +530,10 @@ def main(argv=None) -> int:
         from ..aot.pack import main_pack, main_warm
 
         return main_pack(cfg) if cfg.command == "pack" else main_warm(cfg)
+    if cfg.command == "fleet-ctl":
+        # runtime fleet membership against a running front-end
+        # (fleet/coordinator.py + serve /fleet/members admin surface)
+        return run_fleet_ctl(cfg)
     if cfg.command == "inflight":
         # live in-flight introspection against a running serve process
         # (obs/inflight.py; --serve-host/--serve-port pick the target)

@@ -160,7 +160,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", nargs="?", default="run",
                    choices=["run", "configure", "systemd", "systemd-user",
                             "license", "bench", "serve", "fleet",
-                            "pack", "warm", "inflight"])
+                            "pack", "warm", "inflight", "fleet-ctl"])
+    p.add_argument("subargs", nargs="*", default=[],
+                   help="subcommand arguments (fleet-ctl: list | "
+                        "add SPEC | drain NAME | remove NAME)")
     p.add_argument("--verbose", "-v", action="count", default=0)
     p.add_argument("--auto-update", action="store_true")
     p.add_argument("--conf", help="path to fishnet.ini")
@@ -327,6 +330,7 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.no_stats_file = bool(args.no_stats_file)
     cfg.conf = args.conf
     cfg.no_conf = args.no_conf
+    cfg.extra_args = list(args.subargs)
     return cfg
 
 

@@ -69,15 +69,28 @@ chunk before its scripted action runs (heartbeats continue). Unlike the
 one-shot `slow:S` action this models a member's steady-state speed, so
 fleet load-balancing and scaling tests (tests/test_fleet.py, bench.py
 fleet_scaling) can build deterministically asymmetric members.
+
+`FlakyProxy` (in-process, asyncio) is the NETWORK counterpart of the
+fault scripts: a TCP shim between a remote fleet member (HttpEngine)
+and its serve endpoint that injects connection-level faults —
+`refuse-for:S` (listener closed for S seconds: real ECONNREFUSED, the
+transient fault fleet/faults.py retries in-dispatch), `reset-after-
+headers` (RST after the request head: a mid-stream loss), and
+`delay:MS` (added connect latency). tools/chaos.py --scenario
+fleet-flap drives it.
 """
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
+import socket as _socket
+import struct
 import sys
 import threading
 import time
+from typing import Optional, Tuple
 
 from ..client.ipc import wire_position_fingerprint
 from .frames import FrameError, PipeClosed, read_frame, write_frame
@@ -163,6 +176,152 @@ def _fake_response(wp: dict, cp: int) -> dict:
         "time_s": 0.001,
         "nps": 1000,
     }
+
+
+class FlakyProxy:
+    """Scriptable TCP shim: client ↔ proxy ↔ target, with injectable
+    connection-level faults. Runs inside the caller's event loop (tests
+    and tools/chaos.py build it next to the coordinator).
+
+    Actions (`await set_fault(...)`):
+
+        none                 transparent pipe (the default)
+        refuse-for:S         close the listening socket for S seconds —
+                             connecting clients get a genuine
+                             ECONNREFUSED (kernel RSTs the SYN), the
+                             transient connect-phase fault the fleet
+                             retries in-dispatch; the listener re-opens
+                             on the SAME port when the window ends
+        reset-after-headers  accept, swallow the request head, then RST
+                             (SO_LINGER 0) — the request hit the wire
+                             and died mid-response: a loss, never
+                             retried blindly
+        delay:MS             hold each new connection MS milliseconds
+                             before piping — a slow network path
+    """
+
+    def __init__(self, target_host: str, target_port: int):
+        self.target_host = target_host
+        self.target_port = target_port
+        self.host = "127.0.0.1"
+        self.port = 0
+        self.conns = 0  # connections actually accepted
+        self._mode = "none"
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._resume_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port or 0
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def close(self) -> None:
+        if self._resume_task is not None:
+            self._resume_task.cancel()
+            self._resume_task = None
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def set_fault(self, action: str) -> None:
+        if action in ("", "none"):
+            self._mode = "none"
+            return
+        if action.startswith("refuse-for:"):
+            secs = float(action.split(":", 1)[1])
+            await self._pause_listener(secs)
+            return
+        if action == "reset-after-headers" or action.startswith("delay:"):
+            self._mode = action
+            return
+        raise ValueError(f"flaky_proxy: unknown action {action!r}")
+
+    async def wait_recovered(self) -> None:
+        """Block until a pending refuse-for window has re-opened the
+        listener (chaos scenarios sequence their phases on this)."""
+        if self._resume_task is not None:
+            await self._resume_task
+            self._resume_task = None
+
+    async def _pause_listener(self, secs: float) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+        async def _resume() -> None:
+            await asyncio.sleep(secs)
+            # same port: members keep their configured address across
+            # the outage, exactly like a real host rebooting
+            self._server = await asyncio.start_server(
+                self._handle, self.host, self.port
+            )
+
+        self._resume_task = asyncio.ensure_future(_resume())
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.conns += 1
+        mode = self._mode
+        upstream_w: Optional[asyncio.StreamWriter] = None
+        try:
+            if mode == "reset-after-headers":
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    data = await reader.read(1024)
+                    if not data:
+                        break
+                    buf += data
+                sock = writer.get_extra_info("socket")
+                if sock is not None:
+                    # linger(on, 0): close() sends RST, not FIN — the
+                    # client sees a reset mid-response, not a clean EOF
+                    sock.setsockopt(
+                        _socket.SOL_SOCKET, _socket.SO_LINGER,
+                        struct.pack("ii", 1, 0),
+                    )
+                return
+            if mode.startswith("delay:"):
+                await asyncio.sleep(float(mode.split(":", 1)[1]) / 1000.0)
+            upstream_r, upstream_w = await asyncio.open_connection(
+                self.target_host, self.target_port
+            )
+            await asyncio.gather(
+                self._pipe(reader, upstream_w),
+                self._pipe(upstream_r, writer),
+            )
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass  # either side dropped; the other gets torn down below
+        finally:
+            for w in (writer, upstream_w):
+                if w is None:
+                    continue
+                w.close()
+                try:
+                    await w.wait_closed()
+                except (ConnectionError, OSError):
+                    pass
+
+    @staticmethod
+    async def _pipe(
+        reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                writer.write(data)
+                await writer.drain()
+        finally:
+            try:
+                writer.write_eof()
+            except (OSError, RuntimeError):
+                pass  # transport already closed
 
 
 def main(argv=None) -> int:

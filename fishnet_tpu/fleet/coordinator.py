@@ -35,6 +35,28 @@ a lost member sits out FISHNET_TPU_FLEET_LOSS_WINDOW seconds before
 the planner will consider it again (its own supervisor respawn backoff
 still applies underneath).
 
+Self-healing (ISSUE 15), four coordinated layers on top of that ledger:
+
+- fault taxonomy (fleet/faults.py): the remote transport retries
+  transient faults in-dispatch, surfaces 429 sheds as `MemberBusy`
+  (rerouted here without a loss event, the member parked until its
+  Retry-After hint expires), and only genuine losses run the ladder;
+- probed readmission: after its cooldown a lost member enters
+  probation — a healthz probe plus one canary chunk must succeed before
+  the planner gives it real work; repeated losses escalate the cooldown
+  exponentially up to FISHNET_TPU_FLEET_COOLDOWN_MAX, so a
+  permanently-dead member costs only probes;
+- hedged dispatch (FISHNET_TPU_FLEET_HEDGE, off by default): when a
+  dispatched sub-chunk's deadline slack drops below
+  FISHNET_TPU_FLEET_HEDGE_SLACK_MS and a free member exists, the
+  unfinished positions are duplicated there; first answer wins through
+  the same fingerprint ledger, the loser is discarded and counted —
+  results stay bit-identical with hedging on or off;
+- runtime membership: add_member/begin_drain/drained/remove_member
+  back the serve front-end's /fleet/members admin surface and the
+  `fishnet-tpu fleet-ctl` CLI, so a rolling restart is drain → wait
+  empty → remove → re-add, with zero lost positions.
+
 Observability folds to one pane: member trace rings already merge into
 the shared module recorder (each local supervisor absorbs its child's
 spans with a per-member clock sync), the coordinator adds
@@ -58,19 +80,32 @@ from ..client.ipc import (
     responses_from_wire,
 )
 from ..client.logger import Logger
-from ..client.wire import EngineFlavor
+from ..client.wire import AnalysisWork, EngineFlavor, NodeLimit
 from ..engine.base import EngineError
 from ..engine.session import ChunkSubmit
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..utils import settings
-from .member import FleetMember
+from .faults import MemberBusy
+from .member import FleetMember, make_local_member
+from .remote import HttpEngine
 
 # distinct member losses with the same fingerprint un-acked before the
 # position is declared poison and quarantined fleet-wide
 POISON_THRESHOLD = 2
 
+# the canary is a fixed tiny search (startpos, depth 1): cheap enough
+# that probing a permanently-dead member forever costs ~nothing, real
+# enough that "passed" means the whole dispatch path works
+_CANARY_FEN = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+CANARY_TTL_S = 10.0
+
 _Pair = Tuple[str, WorkPosition]  # (fingerprint, position)
+
+# _dispatch_member outcome tags: how _dispatch_all treats the leftover
+_OK = "ok"
+_LOSS = "loss"  # poison-count, then re-dispatch
+_BUSY = "busy"  # reroute only — never a loss, never poison
 
 
 @dataclass
@@ -98,6 +133,17 @@ class FleetStats:
     losses: int = 0
     quarantined: int = 0  # fingerprints quarantined fleet-wide
     quarantine_routed: int = 0  # positions answered by the fallback
+    busy_reroutes: int = 0  # positions rerouted off a 429 shed
+    probes: int = 0  # probation probes attempted
+    probe_failures: int = 0  # probes that re-escalated the cooldown
+    canaries_ok: int = 0  # canary chunks served during probation
+    readmissions: int = 0  # members readmitted after probation
+    hedges: int = 0  # positions duplicated to a second member
+    hedge_wins: int = 0  # positions whose hedge answered first
+    hedge_losses: int = 0  # hedge dispatches that themselves died
+    drains: int = 0  # members put into drain
+    members_added: int = 0  # runtime membership adds
+    members_removed: int = 0  # runtime membership removals
 
 
 class FleetCoordinator(ChunkSubmit):
@@ -114,6 +160,11 @@ class FleetCoordinator(ChunkSubmit):
         loss_window: Optional[float] = None,
         registry: Optional[obs_metrics.MetricsRegistry] = None,
         fallback_factory=None,
+        hedge: Optional[bool] = None,
+        hedge_slack_ms: Optional[int] = None,
+        probation: Optional[bool] = None,
+        cooldown_max: Optional[float] = None,
+        local_factory=None,
     ) -> None:
         if not members:
             raise ValueError("a fleet needs at least one member")
@@ -127,9 +178,30 @@ class FleetCoordinator(ChunkSubmit):
             settings.get_int("FISHNET_TPU_FLEET_LOSS_WINDOW")
             if loss_window is None else loss_window
         )
+        self.hedge = (
+            settings.get_bool("FISHNET_TPU_FLEET_HEDGE")
+            if hedge is None else bool(hedge)
+        )
+        self.hedge_slack_s = (
+            settings.get_int("FISHNET_TPU_FLEET_HEDGE_SLACK_MS")
+            if hedge_slack_ms is None else int(hedge_slack_ms)
+        ) / 1000.0
+        self.probation = (
+            settings.get_bool("FISHNET_TPU_FLEET_PROBATION")
+            if probation is None else bool(probation)
+        )
+        self.cooldown_max = float(
+            settings.get_int("FISHNET_TPU_FLEET_COOLDOWN_MAX")
+            if cooldown_max is None else cooldown_max
+        )
+        # runtime `add_member("local")` builds through this (app.py
+        # closes it over the Config; tests over a fakehost command line)
+        self.local_factory = local_factory
         self.registry = registry or obs_metrics.REGISTRY
         self.fallback_factory = fallback_factory
         self.stats = FleetStats()
+        self._probe_tasks: Dict[str, asyncio.Task] = {}
+        self._stragglers: Set[asyncio.Task] = set()
         self.loss_log: List[LossEvent] = []
         self._quarantine: Set[str] = set()
         self._poison: Dict[str, int] = {}
@@ -166,6 +238,18 @@ class FleetCoordinator(ChunkSubmit):
 
     async def close(self) -> None:
         self._closing = True
+        probes = list(self._probe_tasks.values())
+        self._probe_tasks.clear()
+        for task in probes:
+            task.cancel()
+        if probes:
+            await asyncio.gather(*probes, return_exceptions=True)
+        # detached straggler dispatches settle their ledgers before the
+        # engines under them are torn down
+        if self._stragglers:
+            await asyncio.gather(
+                *list(self._stragglers), return_exceptions=True
+            )
         engines = [m.engine for m in self.members]
         if self._fallback is not None:
             engines.append(self._fallback)
@@ -174,13 +258,126 @@ class FleetCoordinator(ChunkSubmit):
             *(e.close() for e in engines), return_exceptions=True
         )
 
+    # ------------------------------------------------------------ membership
+
     def begin_drain(self, member_name: Optional[str] = None) -> None:
         """Stop planning work onto a member (or all of them); in-flight
-        sub-chunks finish normally. The autoscaling story in
+        sub-chunks finish normally. The rolling-restart story in
         docs/fleet.md drains a member before removing it."""
         for m in self.members:
             if member_name is None or m.name == member_name:
-                m.draining = True
+                if not m.draining:
+                    m.draining = True
+                    self.stats.drains += 1
+                    obs_trace.instant(
+                        "fleet.drain", "fleet", member=m.name,
+                        backlog=m.backlog, inflight=len(m.inflight),
+                    )
+                    self.logger.info(
+                        f"fleet: draining member {m.name} "
+                        f"({m.backlog} position(s) in flight)"
+                    )
+
+    def drain_member(self, name: str) -> dict:
+        """Validated drain for the admin surface: unknown members raise
+        instead of silently matching nothing. Returns the member's
+        health row plus whether the drain is already complete."""
+        member = self._member(name)
+        self.begin_drain(name)
+        return {"member": member.health(), "drained": self.drained(name)}
+
+    def drained(self, member_name: str) -> bool:
+        """True when a draining member holds no in-flight work — safe
+        to SIGTERM/remove with zero lost positions."""
+        m = self._member(member_name)
+        return m.draining and m.backlog == 0 and not m.inflight
+
+    async def add_member(self, spec: str) -> dict:
+        """Grow the fleet at runtime from one member-spec token
+        ('local' or 'http://host:port'); local members are started
+        before they join the planner. Returns the new health row."""
+        token = spec.strip()
+        if not token:
+            raise EngineError("fleet: empty member spec")
+        if token == "local" or token.startswith("local*"):
+            if "*" in token:
+                raise EngineError(
+                    "fleet: add one member at a time (no 'local*N')"
+                )
+            name = self._next_local_name()
+            factory = self.local_factory or (
+                lambda n: make_local_member(n, logger=self.logger)
+            )
+            member = factory(name)
+            start = getattr(member.engine, "start", None)
+            if start is not None:
+                await start()
+        else:
+            engine = HttpEngine(token)  # validates host:port
+            name = f"{engine.host}:{engine.port}"
+            if any(m.name == name for m in self.members):
+                raise EngineError(f"fleet: member {name} already exists")
+            member = FleetMember(name=name, engine=engine, kind="remote")
+        self.members.append(member)
+        self.stats.members_added += 1
+        obs_trace.instant(
+            "fleet.member-added", "fleet", member=member.name,
+            kind=member.kind,
+        )
+        self.logger.info(
+            f"fleet: member {member.name} added "
+            f"({len(self.members)} member(s))"
+        )
+        self.fold_metrics()
+        return member.health()
+
+    async def remove_member(self, name: str, force: bool = False) -> dict:
+        """Shrink the fleet at runtime. Refuses while the member still
+        holds in-flight work (drain first) unless forced; refuses to
+        remove the last member outright."""
+        member = self._member(name)
+        if len(self.members) == 1:
+            raise EngineError(
+                "fleet: refusing to remove the last member"
+            )
+        if not force and (member.backlog or member.inflight):
+            raise EngineError(
+                f"fleet: member {name} still holds "
+                f"{member.backlog} position(s) — drain it first"
+            )
+        self.members.remove(member)
+        task = self._probe_tasks.pop(member.name, None)
+        if task is not None:
+            task.cancel()
+        try:
+            await member.engine.close()
+        except (EngineError, OSError) as e:
+            self.logger.warn(
+                f"fleet: closing removed member {name} failed: {e}"
+            )
+        self.stats.members_removed += 1
+        obs_trace.instant(
+            "fleet.member-removed", "fleet", member=name, kind=member.kind,
+        )
+        self.logger.info(
+            f"fleet: member {name} removed "
+            f"({len(self.members)} member(s) remain)"
+        )
+        self.fold_metrics()
+        return member.health()
+
+    def _member(self, name: str) -> FleetMember:
+        for m in self.members:
+            if m.name == name:
+                return m
+        raise EngineError(f"fleet: no member named {name!r}")
+
+    def _next_local_name(self) -> str:
+        taken = {m.name for m in self.members}
+        n = 0
+        while f"local{n}" in taken:
+            n += 1
+        return f"local{n}"
 
     # ---------------------------------------------------------------- health
 
@@ -192,6 +389,11 @@ class FleetCoordinator(ChunkSubmit):
             "members_live": sum(1 for h in members if h["available"]),
             "quarantined": len(self._quarantine),
             "losses": self.stats.losses,
+            "hedge": self.hedge,
+            "hedges": self.stats.hedges,
+            "hedge_wins": self.stats.hedge_wins,
+            "readmissions": self.stats.readmissions,
+            "busy_reroutes": self.stats.busy_reroutes,
         }
 
     def fold_metrics(self) -> None:
@@ -208,7 +410,25 @@ class FleetCoordinator(ChunkSubmit):
         reg.gauge(
             "fishnet_fleet_members_total", "Configured fleet members"
         ).set(len(self.members))
+        reg.gauge(
+            "fishnet_fleet_members_probation",
+            "Fleet members awaiting a healthz probe + canary chunk",
+        ).set(sum(1 for m in self.members if m.probation))
+        reg.gauge(
+            "fishnet_fleet_members_draining",
+            "Fleet members finishing in-flight work before removal",
+        ).set(sum(1 for m in self.members if m.draining))
         reg.absorb_totals("fishnet_fleet", asdict(self.stats))
+        # the hedging acceptance counters under their contract names
+        # (docs/fleet.md): duplicates dispatched, duplicates that won
+        reg.counter(
+            "fleet_hedges_total",
+            "Positions duplicated to a second member by hedged dispatch",
+        ).set_total(self.stats.hedges)
+        reg.counter(
+            "fleet_hedge_wins_total",
+            "Hedged positions whose duplicate answered first",
+        ).set_total(self.stats.hedge_wins)
         for m in self.members:
             reg.gauge(
                 f"fishnet_fleet_backlog_{m.name}",
@@ -268,11 +488,22 @@ class FleetCoordinator(ChunkSubmit):
     ) -> None:
         """Dispatch rounds until every pending position has a result.
         Round 1 is the normal spread; later rounds re-dispatch only what
-        a lost member left un-acked."""
+        a lost member left un-acked (or a shedding member bounced)."""
         rounds = 0
         while pending:
+            self._kick_probes()
             now = time.monotonic()
             available = [m for m in self.members if m.available(now)]
+            if not available:
+                # last resorts, in order: a due probation probe may
+                # readmit someone (bounded by the canary TTL), or every
+                # member is merely shedding and the earliest Retry-After
+                # hint expires inside the chunk deadline
+                await self.probe_members()
+                now = time.monotonic()
+                available = [m for m in self.members if m.available(now)]
+            if not available:
+                available = await self._wait_out_backpressure(chunk, now)
             if not available:
                 raise EngineError(
                     "fleet: no live members "
@@ -295,17 +526,47 @@ class FleetCoordinator(ChunkSubmit):
                 for fp, wp in assigned:
                     member.acked.pop(fp, None)
                     member.inflight[fp] = wp
-            leftovers = await asyncio.gather(
-                *(
+            tasks = [
+                asyncio.ensure_future(
                     self._dispatch_member(member, chunk, assigned, results)
-                    for member, assigned in plan
                 )
-            )
+                for member, assigned in plan
+            ]
+            hedger = None
+            if self.hedge and len(self.members) > 1:
+                hedger = asyncio.ensure_future(
+                    self._hedge_watch(chunk, plan, tasks, results)
+                )
+            # First-answer-wins applies to the round barrier too: once
+            # every fingerprint this round owns has an answer (a hedge
+            # can get there before the straggler's own dispatch comes
+            # back) the chunk is done — the straggler keeps running
+            # detached to settle its ledger and is reaped on close().
+            # A task that leaves unanswered work always completes before
+            # the barrier lifts, so its leftover is never orphaned.
+            waiting = set(tasks)
+            if hedger is not None:
+                # the hedger completes right after its hedge answers
+                # land — it must be able to lift the barrier itself
+                waiting.add(hedger)
+            fps_round = [fp for _, assigned in plan for fp, _ in assigned]
+            while waiting and not all(fp in results for fp in fps_round):
+                _, waiting = await asyncio.wait(
+                    waiting, return_when=asyncio.FIRST_COMPLETED
+                )
+            for task in waiting:
+                self._detach(task)
+            outcomes = [t.result() for t in tasks if t.done()]
             pending = []
-            for leftover in leftovers:
+            for status, leftover in outcomes:
                 for fp, wp in leftover:
                     if fp in results:
                         continue  # first answer won while we re-planned
+                    if status == _BUSY:
+                        # a shed is a reroute, never poison evidence —
+                        # the member is healthy, just full
+                        pending.append((fp, wp))
+                        continue
                     count = self._poison.get(fp, 0) + 1
                     self._poison[fp] = count
                     if count >= POISON_THRESHOLD:
@@ -328,6 +589,33 @@ class FleetCoordinator(ChunkSubmit):
                     f"fleet: re-dispatching {len(pending)} un-acked "
                     f"position(s) to survivors (round {rounds})"
                 )
+
+    def _detach(self, task: asyncio.Task) -> None:
+        """Let a superseded dispatch finish in the background (its
+        `finally` settles the member ledger); close() reaps the set."""
+        self._stragglers.add(task)
+        task.add_done_callback(self._stragglers.discard)
+
+    async def _wait_out_backpressure(
+        self, chunk: Chunk, now: float
+    ) -> List[FleetMember]:
+        """Every member is parked on a 429 Retry-After hint: sleep
+        until the earliest hint expires (bounded by the chunk deadline)
+        rather than failing the chunk — backpressure is a wait, not an
+        outage."""
+        hints = [
+            m.busy_until for m in self.members
+            if not m.draining and not m.probation
+            and now >= m.down_until and m.busy_until > now
+        ]
+        if not hints:
+            return []
+        wake = min(hints)
+        if wake >= chunk.deadline:
+            return []
+        await asyncio.sleep(max(wake - now, 0.0) + 0.005)
+        now = time.monotonic()
+        return [m for m in self.members if m.available(now)]
 
     def _plan(
         self, pending: List[_Pair], available: List[FleetMember]
@@ -352,11 +640,18 @@ class FleetCoordinator(ChunkSubmit):
         chunk: Chunk,
         assigned: List[_Pair],
         results: Dict[str, PositionResponse],
-    ) -> List[_Pair]:
-        """One member's sub-chunk; returns the un-acked leftover (empty
-        on success). The caller has already charged this work to the
-        member's ledger (backlog, in-flight) — this method only runs the
-        engine call and settles the ledger in its `finally`."""
+        hedge: bool = False,
+    ) -> Tuple[str, List[_Pair]]:
+        """One member's sub-chunk; returns (outcome, leftover) where
+        the leftover is empty on success and the outcome tag tells
+        `_dispatch_all` whether the leftover is loss evidence (_LOSS:
+        poison-count and re-dispatch) or a bounce off a healthy-but-full
+        member (_BUSY: reroute only). The caller has already charged
+        this work to the member's ledger (backlog, in-flight) — this
+        method only runs the engine call and settles the ledger in its
+        `finally`. Hedge dispatches (`hedge=True`) write through the
+        same first-answer-wins ledger but never feed leftovers back:
+        the primary still owns the positions."""
         n = len(assigned)
         sub = replace(chunk, positions=[wp for _, wp in assigned])
         # sampled request contexts in this sub-chunk: the dispatch span
@@ -383,9 +678,44 @@ class FleetCoordinator(ChunkSubmit):
                     f"fleet member {member.name} returned "
                     f"{len(responses)} results for {n} positions"
                 )
+            # first answer wins: with hedging a fingerprint can be in
+            # flight on two members; whichever lands second is discarded
+            # here, keeping results bit-identical hedge on or off
+            wins = 0
             for (fp, _), res in zip(assigned, responses):
+                if fp in results:
+                    continue
                 results[fp] = res
-            return []
+                if hedge:
+                    wins += 1
+            if hedge and wins:
+                self.stats.hedge_wins += wins
+                obs_trace.instant(
+                    "fleet.hedge-win", "fleet", member=member.name,
+                    positions=wins, batch=str(chunk.work.id),
+                )
+            member.consecutive_losses = 0
+            return (_OK, [])
+        except MemberBusy as e:
+            # designed backpressure (429 + Retry-After): park the
+            # member until the hint expires and bounce the positions
+            # back for rerouting — never a loss event, never poison
+            member.busy_until = time.monotonic() + max(e.retry_after, 0.1)
+            leftover = [
+                (fp, wp) for fp, wp in assigned if fp not in results
+            ]
+            if not hedge:
+                self.stats.busy_reroutes += len(leftover)
+            obs_trace.instant(
+                "fleet.member-busy", "fleet", member=member.name,
+                retry_after=e.retry_after, positions=len(leftover),
+            )
+            self.logger.warn(
+                f"fleet: member {member.name} shedding (429, retry "
+                f"after {e.retry_after:.0f}s); rerouting "
+                f"{len(leftover)} position(s)"
+            )
+            return (_BUSY, [] if hedge else leftover)
         except EngineError as e:
             # harvest what the member acked before dying: those
             # positions are answered, not re-searched
@@ -407,9 +737,19 @@ class FleetCoordinator(ChunkSubmit):
             leftover = [
                 (fp, wp) for fp, wp in assigned if fp not in results
             ]
+            if hedge:
+                # the hedge member genuinely died (cooldown and all),
+                # but the primary still owns these positions — nothing
+                # feeds back into re-dispatch from this side
+                self.stats.hedge_losses += 1
+                self._note_loss(
+                    member, f"hedge dispatch: {e}",
+                    [fp for fp, _ in assigned], acked, None,
+                )
+                return (_LOSS, [])
             self._note_loss(member, str(e), [fp for fp, _ in assigned],
                             acked, leftover)
-            return leftover
+            return (_LOSS, leftover)
         finally:
             member.backlog -= n
             for fp, _ in assigned:
@@ -427,10 +767,17 @@ class FleetCoordinator(ChunkSubmit):
         leftover: Optional[List[_Pair]] = None,
     ) -> None:
         """Exactly one breaker-visible event per member death: cooldown,
-        loss counters, trace instant, flight dump, LossEvent record."""
+        loss counters, trace instant, flight dump, LossEvent record.
+        Consecutive losses escalate the cooldown exponentially (capped
+        at cooldown_max) and arm probation: the member re-enters only
+        through a healthz probe + canary chunk (flap damping)."""
         now = time.monotonic()
         member.losses += 1
-        member.down_until = now + self.loss_window
+        member.consecutive_losses += 1
+        cooldown = self._cooldown(member)
+        member.down_until = now + cooldown
+        if self.probation:
+            member.probation = True
         self.stats.losses += 1
         redisp = tuple(fp for fp, _ in (leftover or []))
         event = LossEvent(
@@ -451,14 +798,192 @@ class FleetCoordinator(ChunkSubmit):
             "fleet.member-loss", "fleet", member=member.name,
             reason=reason, inflight=len(inflight_fps),
             acked=len(acked), redispatched=len(redisp),
+            cooldown_s=round(cooldown, 1), probation=member.probation,
             trace_ids=[t for t in tids if obs_trace.sampled(t)],
         )
         self.logger.error(
             f"fleet: member {member.name} lost ({reason}); "
             f"{len(acked)} ack(s) harvested, {len(redisp)} position(s) "
-            f"to re-dispatch; cooling down {self.loss_window:.0f}s"
+            f"to re-dispatch; cooling down {cooldown:.0f}s"
+            + (" then probation" if member.probation else "")
         )
         self._flight_dump("member-loss", f"{member.name}: {reason}")
+
+    def _cooldown(self, member: FleetMember) -> float:
+        """Escalating cooldown: loss_window doubled per consecutive
+        loss, capped at cooldown_max (flap damping)."""
+        n = max(member.consecutive_losses, 1)
+        return min(self.loss_window * (2.0 ** (n - 1)), self.cooldown_max)
+
+    # ------------------------------------------------------- probation/canary
+
+    def _kick_probes(self, now: Optional[float] = None) -> None:
+        """Start a background probe for every member whose cooldown has
+        expired into probation. Called opportunistically from the
+        dispatch path — probing never blocks real work."""
+        if not self.probation or self._closing:
+            return
+        if now is None:
+            now = time.monotonic()
+        for m in self.members:
+            if m.probe_due(now) and m.name not in self._probe_tasks:
+                m.probing = True
+                task = asyncio.ensure_future(self._probe_member(m))
+                self._probe_tasks[m.name] = task
+                task.add_done_callback(
+                    lambda t, name=m.name:
+                    self._probe_tasks.pop(name, None)
+                )
+
+    async def probe_members(self) -> None:
+        """Kick and await every due probe — the synchronous form the
+        tests, chaos scenarios, and fleet-ctl use."""
+        self._kick_probes()
+        tasks = list(self._probe_tasks.values())
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _probe_member(self, member: FleetMember) -> None:
+        """Probation gauntlet: healthz (if the engine speaks it), then
+        one canary chunk. Success readmits the member; failure is NOT a
+        loss event (no work was at risk) — it just escalates the
+        cooldown before the next probe, so a permanently-dead member
+        costs probes, never re-dispatched work."""
+        try:
+            with obs_trace.span(
+                "fleet.probe", "fleet", member=member.name
+            ):
+                self.stats.probes += 1
+                hz = getattr(member.engine, "healthz", None)
+                if hz is not None:
+                    await hz()
+                canary = self._canary_chunk(member.name)
+                fp = position_fingerprint(canary.positions[0])
+                obs_trace.instant(
+                    "fleet.canary", "fleet", member=member.name
+                )
+                responses = await member.engine.go_multiple(canary)
+                # canary acks must not linger in the exactly-once ledger
+                member.acked.pop(fp, None)
+                if len(responses) != 1:
+                    raise EngineError(
+                        f"fleet member {member.name} canary returned "
+                        f"{len(responses)} result(s)"
+                    )
+            member.probation = False
+            member.down_until = 0.0
+            member.busy_until = 0.0
+            member.canaries_ok += 1
+            self.stats.canaries_ok += 1
+            self.stats.readmissions += 1
+            obs_trace.instant(
+                "fleet.readmit", "fleet", member=member.name
+            )
+            self.logger.info(
+                f"fleet: member {member.name} readmitted "
+                "(healthz + canary ok)"
+            )
+        except EngineError as e:
+            member.consecutive_losses += 1
+            cooldown = self._cooldown(member)
+            member.down_until = time.monotonic() + cooldown
+            self.stats.probe_failures += 1
+            obs_trace.instant(
+                "fleet.probe-failed", "fleet", member=member.name,
+                reason=str(e), cooldown_s=round(cooldown, 1),
+            )
+            self.logger.warn(
+                f"fleet: probe of {member.name} failed ({e}); "
+                f"cooling down {cooldown:.0f}s"
+            )
+        finally:
+            member.probing = False
+
+    def _canary_chunk(self, member_name: str) -> Chunk:
+        work = AnalysisWork(
+            id=f"canary-{member_name}",
+            nodes=NodeLimit(sf16=10_000, classical=20_000),
+            timeout_s=CANARY_TTL_S, depth=1, multipv=None,
+        )
+        wp = WorkPosition(
+            work=work, position_index=0, url=None, skip=False,
+            root_fen=_CANARY_FEN, moves=[],
+        )
+        return Chunk(
+            work=work, deadline=time.monotonic() + CANARY_TTL_S,
+            variant="standard", flavor=EngineFlavor.TPU, positions=[wp],
+        )
+
+    # ----------------------------------------------------------- hedging
+
+    async def _hedge_watch(
+        self,
+        chunk: Chunk,
+        plan: List[Tuple[FleetMember, List[_Pair]]],
+        tasks: List[asyncio.Task],
+        results: Dict[str, PositionResponse],
+    ) -> None:
+        """Tail-latency insurance: wait until the chunk's deadline
+        slack shrinks to hedge_slack_s; any sub-chunk still unanswered
+        then is duplicated to a member with free capacity. First answer
+        wins through the fingerprint ledger (results), the loser is
+        discarded and counted."""
+        delay = (chunk.deadline - self.hedge_slack_s) - time.monotonic()
+        if delay > 0:
+            _, still_running = await asyncio.wait(tasks, timeout=delay)
+            if not still_running:
+                return  # everyone answered with slack to spare
+        now = time.monotonic()
+        if now >= chunk.deadline:
+            return
+        hedge_calls = []
+        for (member, assigned), task in zip(plan, tasks):
+            if task.done():
+                continue
+            unfinished = [
+                (fp, wp) for fp, wp in assigned if fp not in results
+            ]
+            if not unfinished:
+                continue
+            target = self._hedge_target(member, now)
+            if target is None:
+                continue  # nobody free — hedging never queues work
+            self.stats.hedges += len(unfinished)
+            obs_trace.instant(
+                "fleet.hedge", "fleet", slow=member.name,
+                target=target.name, positions=len(unfinished),
+                batch=str(chunk.work.id),
+            )
+            self.logger.warn(
+                f"fleet: hedging {len(unfinished)} position(s) from "
+                f"{member.name} to {target.name} "
+                f"({(chunk.deadline - now) * 1000:.0f}ms slack left)"
+            )
+            # same synchronous ledger charge as _dispatch_all's plan
+            target.backlog += len(unfinished)
+            target.dispatched_positions += len(unfinished)
+            self.stats.dispatches += 1
+            self.stats.dispatched_positions += len(unfinished)
+            for fp, wp in unfinished:
+                target.acked.pop(fp, None)
+                target.inflight[fp] = wp
+            hedge_calls.append(
+                self._dispatch_member(
+                    target, chunk, unfinished, results, hedge=True
+                )
+            )
+        if hedge_calls:
+            await asyncio.gather(*hedge_calls)
+
+    def _hedge_target(
+        self, slow: FleetMember, now: float
+    ) -> Optional[FleetMember]:
+        """A healthy member with free capacity (empty backlog) that
+        isn't the straggler itself."""
+        for m in self.members:
+            if m is not slow and m.backlog == 0 and m.available(now):
+                return m
+        return None
 
     def _quarantine_fp(self, fp: str) -> None:
         if fp in self._quarantine:

@@ -1,0 +1,90 @@
+"""Fault taxonomy for fleet member dispatch.
+
+The reference client survives flaky volunteer machines because it never
+confuses "the network hiccuped" with "the worker is gone" — backoff and
+retry on the former, give the work away on the latter. The fleet's
+version of that distinction lives here, as three fault kinds a remote
+dispatch can surface:
+
+    transient   connect refused / reset / timeout BEFORE the request was
+                written — the member never saw the work, so retrying the
+                same dispatch is safe and costs nothing but backoff.
+    busy        HTTP 429 from serve/admission.py — a *designed*
+                backpressure answer carrying Retry-After. The member is
+                healthy and loaded, not dead; the coordinator reroutes
+                the positions and leaves the member alone until the
+                hint expires. Never a loss event.
+    loss        the request hit the wire and the answer never (fully)
+                came back, or transient retries exhausted their budget —
+                the member may be searching the positions, may be gone;
+                either way the exactly-once ledger takes over (harvest
+                acks, re-dispatch the remainder, cooldown).
+
+`classify(exc, wrote=...)` maps a transport exception onto a kind; the
+`wrote` flag is the load-bearing bit: the same ConnectionResetError is
+transient before the request bytes left this host and a loss after.
+`MemberFault` subclasses EngineError so every existing handler still
+fires; `MemberBusy` additionally carries the Retry-After hint.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ..engine.base import EngineError
+
+FAULT_TRANSIENT = "transient"
+FAULT_BUSY = "busy"
+FAULT_LOSS = "loss"
+
+# transport exceptions that mean "the connection itself failed" — the
+# classification table in tests/test_fleet_health.py pins this set
+_TRANSPORT_ERRORS = (
+    ConnectionError,
+    OSError,
+    asyncio.IncompleteReadError,
+    asyncio.TimeoutError,
+    TimeoutError,
+)
+
+
+class MemberFault(EngineError):
+    """An EngineError with a fault kind the coordinator can route on."""
+
+    kind = FAULT_LOSS
+
+    def __init__(self, message: str, *, kind: str | None = None):
+        super().__init__(message)
+        if kind is not None:
+            self.kind = kind
+
+    @property
+    def retriable(self) -> bool:
+        return self.kind == FAULT_TRANSIENT
+
+
+class MemberBusy(MemberFault):
+    """HTTP 429 backpressure: reroute, don't bury (satellite bugfix —
+    HttpEngine used to raise this as a plain EngineError and the
+    coordinator counted a member death for a designed shed answer)."""
+
+    kind = FAULT_BUSY
+
+    def __init__(self, message: str, *, retry_after: float = 1.0):
+        super().__init__(message, kind=FAULT_BUSY)
+        self.retry_after = max(float(retry_after), 0.0)
+
+
+def classify(exc: BaseException, *, wrote: bool) -> str:
+    """Transport exception → fault kind.
+
+    Anything after the request was written is a loss: the member may
+    already be searching, so a blind retry would double-execute and the
+    deadline slack is mostly spent anyway. Before the write, connection
+    failures and timeouts are transient — the member provably never
+    received the work.
+    """
+    if wrote:
+        return FAULT_LOSS
+    if isinstance(exc, _TRANSPORT_ERRORS):
+        return FAULT_TRANSIENT
+    return FAULT_LOSS

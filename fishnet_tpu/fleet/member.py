@@ -44,7 +44,17 @@ _MEMBER_BREAKER_THRESHOLD = 1_000_000
 
 @dataclass
 class FleetMember:
-    """One engine plus the coordinator's ledger for it."""
+    """One engine plus the coordinator's ledger for it.
+
+    Lifecycle (docs/fleet.md has the full diagram): an eligible member
+    takes dispatches; a loss puts it in cooldown (`down_until`,
+    escalating with `consecutive_losses`); when the cooldown expires it
+    sits in *probation* — the coordinator must pass a healthz probe and
+    one canary chunk through it (`probing` while that runs) before it
+    is eligible again. A 429 shed parks it until `busy_until` without
+    touching the loss ladder. `draining` excludes it from planning while
+    in-flight work finishes, after which it can be removed.
+    """
 
     name: str
     engine: object  # Engine protocol (go_multiple/close)
@@ -53,22 +63,57 @@ class FleetMember:
     inflight: Dict[str, WorkPosition] = field(default_factory=dict)
     acked: Dict[str, dict] = field(default_factory=dict)  # fp -> wire
     down_until: float = 0.0  # monotonic; loss cooldown
+    busy_until: float = 0.0  # monotonic; 429 Retry-After backpressure
     draining: bool = False
+    probation: bool = False  # must pass healthz + canary to re-enter
+    probing: bool = False  # a probe is in flight right now
     losses: int = 0
+    consecutive_losses: int = 0  # resets on a served sub-chunk
+    canaries_ok: int = 0
     dispatched_positions: int = 0
 
     def available(self, now: Optional[float] = None) -> bool:
-        """Eligible for new work: not draining, not in loss cooldown,
-        breaker (if the engine has one) not open."""
+        """Eligible for new work: not draining, not in loss cooldown or
+        probation, not shedding (429), breaker (if any) not open."""
         if self.draining:
             return False
         if now is None:
             now = time.monotonic()
         if now < self.down_until:
             return False
+        if self.probation:
+            return False
+        if now < self.busy_until:
+            return False
         if getattr(self.engine, "breaker_open", False):
             return False
         return True
+
+    def probe_due(self, now: Optional[float] = None) -> bool:
+        """Cooldown over, probation pending, no probe running yet."""
+        if not self.probation or self.probing or self.draining:
+            return False
+        if now is None:
+            now = time.monotonic()
+        return now >= self.down_until
+
+    def state(self, now: Optional[float] = None) -> str:
+        """One-word lifecycle state for health tables and fleet-ctl."""
+        if now is None:
+            now = time.monotonic()
+        if self.draining:
+            return "draining"
+        if now < self.down_until:
+            return "cooldown"
+        if self.probing:
+            return "probing"
+        if self.probation:
+            return "probation"
+        if now < self.busy_until:
+            return "busy"
+        if getattr(self.engine, "breaker_open", False):
+            return "breaker-open"
+        return "eligible"
 
     def health(self, now: Optional[float] = None) -> dict:
         """Flat health snapshot (docs/fleet.md: autoscaling signals)."""
@@ -84,12 +129,16 @@ class FleetMember:
         return {
             "name": self.name,
             "kind": self.kind,
+            "state": self.state(now),
             "available": self.available(now),
             "backlog": self.backlog,
             "inflight": len(self.inflight),
             "losses": self.losses,
+            "consecutive_losses": self.consecutive_losses,
+            "canaries_ok": self.canaries_ok,
             "draining": self.draining,
             "cooldown_s": max(self.down_until - now, 0.0),
+            "busy_s": max(self.busy_until - now, 0.0),
             "heartbeat_age_s": hb,
             "aot": aot,
         }

@@ -28,8 +28,9 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from ..client.ipc import Chunk, PositionResponse, responses_from_wire
@@ -37,9 +38,16 @@ from ..client.wire import AnalysisWork, MoveWork
 from ..engine.base import EngineError
 from ..engine.session import PRIORITY_BATCH, ChunkSubmit, PositionRequest
 from ..serve.protocol import ServeRequest, request_to_json
+from ..utils import settings
+from .faults import FAULT_LOSS, FAULT_TRANSIENT, MemberBusy, MemberFault, classify
 
 DEFAULT_TIMEOUT_S = 30.0
 MAX_RESPONSE_BYTES = 8 * 1024 * 1024
+# transient-retry backoff: first pause ~RETRY_BASE_S, doubling with
+# jitter, each pause additionally clamped to the remaining deadline
+# slack so the retry budget can never outlive the chunk
+RETRY_BASE_S = 0.05
+RETRY_PAUSE_CAP_S = 2.0
 
 
 def parse_member_url(url: str) -> Tuple[str, int]:
@@ -103,10 +111,20 @@ def chunk_to_serve_request(chunk: Chunk, now: Optional[float] = None) -> dict:
 class HttpEngine(ChunkSubmit):
     """`Engine` over a remote serve endpoint; one POST per chunk."""
 
-    def __init__(self, url: str, timeout_s: float = DEFAULT_TIMEOUT_S):
+    def __init__(
+        self,
+        url: str,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        retry_max: Optional[int] = None,
+    ):
         self.host, self.port = parse_member_url(url)
         self.url = f"http://{self.host}:{self.port}"
         self.timeout_s = timeout_s
+        self.retry_max = (
+            settings.get_int("FISHNET_TPU_FLEET_RETRY_MAX")
+            if retry_max is None else int(retry_max)
+        )
+        self.retries = 0  # transient faults retried in-dispatch
 
     # ------------------------------------------------------------- dispatch
 
@@ -120,7 +138,24 @@ class HttpEngine(ChunkSubmit):
             raise EngineError(
                 f"fleet member {self.url}: chunk deadline already passed"
             )
-        status, payload = await self._round_trip("POST", path, body, budget)
+        status, payload, retry_after = await self._round_trip(
+            "POST", path, body, budget
+        )
+        if status == 429:
+            # admission shed (serve/admission.py): designed backpressure,
+            # not member death — surface the Retry-After hint so the
+            # coordinator reroutes without a loss event
+            hint = retry_after
+            if isinstance(payload, dict) and "retry_after" in payload:
+                try:
+                    hint = float(payload["retry_after"])
+                except (TypeError, ValueError):
+                    pass
+            raise MemberBusy(
+                f"fleet member {self.url} shed batch {chunk.work.id} "
+                f"(retry after {hint:.0f}s)",
+                retry_after=hint,
+            )
         if status != 200:
             detail = payload.get("error", "") if isinstance(payload, dict) \
                 else ""
@@ -156,7 +191,7 @@ class HttpEngine(ChunkSubmit):
     async def healthz(self, timeout_s: float = 2.0) -> dict:
         """The serve endpoint's liveness/occupancy summary — the fleet's
         remote heartbeat (queued/inflight feed backlog accounting)."""
-        status, payload = await self._round_trip(
+        status, payload, _ = await self._round_trip(
             "GET", "/healthz", None, timeout_s
         )
         if status != 200 or not isinstance(payload, dict):
@@ -173,24 +208,69 @@ class HttpEngine(ChunkSubmit):
     async def _round_trip(
         self, method: str, path: str, body_obj: Optional[dict],
         timeout_s: float,
-    ) -> Tuple[int, object]:
+    ) -> Tuple[int, object, float]:
+        """One logical request: transient faults (fleet/faults.py) are
+        retried in-dispatch with jittered exponential backoff, bounded
+        by BOTH an attempt cap (retry_max) and the deadline slack — a
+        single RST never costs a member-loss event. Loss-kind faults
+        and exhausted retries escalate as MemberFault(kind=loss)."""
+        deadline = time.monotonic() + timeout_s
+        pause = RETRY_BASE_S
+        last: Optional[MemberFault] = None
+        for attempt in range(self.retry_max + 1):
+            slack = deadline - time.monotonic()
+            if slack <= 0:
+                break
+            try:
+                return await self._attempt(method, path, body_obj, slack)
+            except MemberFault as fault:
+                if fault.kind != FAULT_TRANSIENT:
+                    raise
+                last = fault
+                if attempt < self.retry_max:
+                    self.retries += 1
+                    nap = min(
+                        pause * (0.5 + random.random()),
+                        max(deadline - time.monotonic(), 0.0),
+                    )
+                    pause = min(pause * 2.0, RETRY_PAUSE_CAP_S)
+                    if nap > 0:
+                        await asyncio.sleep(nap)
+        raise MemberFault(
+            f"fleet member {self.url}: transient fault persisted past "
+            f"the retry budget ({last})",
+            kind=FAULT_LOSS,
+        ) from last
+
+    async def _attempt(
+        self, method: str, path: str, body_obj: Optional[dict],
+        timeout_s: float,
+    ) -> Tuple[int, object, float]:
+        """One wire attempt, classified: the `wrote` flag survives the
+        wait_for cancellation, so a timeout (or reset) before the
+        request bytes left this host is transient, after is loss."""
+        state: Dict[str, bool] = {"wrote": False}
         try:
             return await asyncio.wait_for(
-                self._round_trip_inner(method, path, body_obj),
+                self._round_trip_inner(method, path, body_obj, state),
                 timeout=timeout_s,
             )
-        except asyncio.TimeoutError:
-            raise EngineError(
-                f"fleet member {self.url}: no answer within {timeout_s:.1f}s"
+        except asyncio.TimeoutError as e:
+            raise MemberFault(
+                f"fleet member {self.url}: no answer within "
+                f"{timeout_s:.1f}s",
+                kind=classify(e, wrote=state["wrote"]),
             ) from None
         except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
-            raise EngineError(
-                f"fleet member {self.url}: connection failed: {e}"
+            raise MemberFault(
+                f"fleet member {self.url}: connection failed: {e}",
+                kind=classify(e, wrote=state["wrote"]),
             ) from e
 
     async def _round_trip_inner(
-        self, method: str, path: str, body_obj: Optional[dict]
-    ) -> Tuple[int, object]:
+        self, method: str, path: str, body_obj: Optional[dict],
+        state: Optional[Dict[str, bool]] = None,
+    ) -> Tuple[int, object, float]:
         payload = b"" if body_obj is None else \
             json.dumps(body_obj).encode("utf-8")
         reader, writer = await asyncio.open_connection(self.host, self.port)
@@ -202,6 +282,8 @@ class HttpEngine(ChunkSubmit):
                 f"Content-Length: {len(payload)}\r\n"
                 "Connection: close\r\n\r\n"
             )
+            if state is not None:
+                state["wrote"] = True
             writer.write(head.encode("latin-1") + payload)
             await writer.drain()
             status_line = await reader.readline()
@@ -212,12 +294,14 @@ class HttpEngine(ChunkSubmit):
                 )
             status = int(parts[1])
             length = 0
+            retry_after = 1.0
             while True:
                 line = await reader.readline()
                 if line in (b"\r\n", b"\n", b""):
                     break
                 name, _, value = line.decode("latin-1").partition(":")
-                if name.strip().lower() == "content-length":
+                lowered = name.strip().lower()
+                if lowered == "content-length":
                     try:
                         length = int(value.strip())
                     except ValueError:
@@ -225,6 +309,11 @@ class HttpEngine(ChunkSubmit):
                             f"fleet member {self.url} sent a bad "
                             "Content-Length"
                         ) from None
+                elif lowered == "retry-after":
+                    try:
+                        retry_after = float(value.strip())
+                    except ValueError:
+                        pass  # date-form Retry-After: keep the default
             if length > MAX_RESPONSE_BYTES:
                 raise EngineError(
                     f"fleet member {self.url} response too large ({length}B)"
@@ -237,8 +326,9 @@ class HttpEngine(ChunkSubmit):
             except (ConnectionError, OSError):
                 pass  # close raced the peer's reset; already closed
         try:
-            return status, json.loads(raw.decode("utf-8")) if raw else {}
+            body = json.loads(raw.decode("utf-8")) if raw else {}
         except (ValueError, UnicodeDecodeError) as e:
             raise EngineError(
                 f"fleet member {self.url} sent a non-JSON body: {e}"
             ) from e
+        return status, body, retry_after

@@ -31,6 +31,14 @@ that true:
                        handler freezes every connection the event loop
                        owns; use asyncio streams / asyncio.sleep /
                        run_in_executor instead
+  conc-unbounded-retry an unbounded loop (`while True`, for-over-
+                       itertools.count) that awaits a network call and
+                       catches transport-level failures back into the
+                       next iteration — a dead peer spins the retry
+                       forever; bound it with an attempt cap
+                       (`for attempt in range(N)`) or a deadline guard
+                       that breaks/raises (fleet/remote.py's in-dispatch
+                       retry is the canonical shape)
 
 Scopes: the timeout/lock rules run on the process-boundary modules
 (supervisor, host, uci, workers, queue), on fishnet_tpu/serve/ (the
@@ -105,6 +113,33 @@ _BLOCKING_IN_LOOP_EXACT = ("time.sleep", "socket.socket",
 _BLOCKING_IN_LOOP_TAILS = ("accept", "connect", "recv", "recv_into",
                            "sendall", "makefile", "urlopen",
                            "HTTPConnection", "HTTPSConnection")
+
+# modules that talk to peers over the wire: an unbounded retry loop
+# here turns one dead peer into a coroutine that spins forever
+RETRY_SCOPE = ("fishnet_tpu/fleet", "fishnet_tpu/serve",
+               "fishnet_tpu/client")
+
+# awaited call tails that reach the network. Deliberately narrow:
+# `acquire`/`go_multiple` are absent so the work queue's long-poll
+# (client/queue.py) and the worker dispatch loop (client/workers.py)
+# stay clean — their loops are exit-condition driven, not retry loops
+_RETRY_NET_TAILS = ("open_connection", "open_unix_connection",
+                    "readline", "readexactly", "readuntil", "drain",
+                    "sendall", "urlopen", "getresponse",
+                    "_round_trip", "_round_trip_inner", "_attempt",
+                    "healthz")
+
+# transport-level exception tails: catching one of these and looping
+# again is a retry. Application errors (ApiError, ShuttingDown) are
+# excluded — handlers for those encode protocol flow, not redial
+_RETRY_EXC_TAILS = ("OSError", "ConnectionError", "ConnectionRefusedError",
+                    "ConnectionResetError", "ConnectionAbortedError",
+                    "BrokenPipeError", "TimeoutError",
+                    "IncompleteReadError", "EngineError", "MemberFault",
+                    "MemberBusy")
+
+# for-loop iterables that never run dry
+_RETRY_INFINITE_ITERS = ("count", "cycle", "repeat")
 
 # the scheduler loops: blocking host syncs here stall the segment
 # pipeline — engine/tpu.py holds the LaneScheduler, ops/search.py the
@@ -376,6 +411,109 @@ def _check_sock_in_loop(src, findings: List[Finding]) -> None:
                 ))
 
 
+def _loop_unbounded(loop: ast.AST) -> bool:
+    """True for loops with no intrinsic iteration cap: `while True`
+    (or any constant-true test) and `for _ in itertools.count()`-style
+    infinite iterables. A `while` over a real condition or a `for`
+    over range()/a collection bounds itself."""
+    if isinstance(loop, ast.While):
+        return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+    if isinstance(loop, ast.For):
+        it = loop.iter
+        return isinstance(it, ast.Call) and \
+            dotted(it.func).split(".")[-1] in _RETRY_INFINITE_ITERS
+    return False
+
+
+def _walk_loop_body(loop: ast.AST):
+    """Walk a loop body, skipping nested function defs (their loops are
+    judged on their own) but descending into nested loops/try/if."""
+    stack = list(loop.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _deadline_guarded(loop: ast.AST) -> bool:
+    """A loop escapes the retry rule if its body carries a deadline
+    guard: an `if` whose test consults a deadline/monotonic clock and
+    whose body leaves the loop (break/return/raise)."""
+    for node in _walk_loop_body(loop):
+        if not isinstance(node, ast.If):
+            continue
+        mentions_clock = False
+        for sub in ast.walk(node.test):
+            name = ""
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            low = name.lower()
+            if "deadline" in low or "monotonic" in low or "slack" in low:
+                mentions_clock = True
+                break
+        if not mentions_clock:
+            continue
+        for sub in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(sub, (ast.Break, ast.Return, ast.Raise)):
+                return True
+    return False
+
+
+def _handler_reiterates(handler: ast.ExceptHandler) -> bool:
+    """A handler permits another lap unless its last statement
+    unconditionally leaves the loop."""
+    if not handler.body:
+        return True
+    return not isinstance(handler.body[-1], (ast.Raise, ast.Break,
+                                             ast.Return))
+
+
+def _check_unbounded_retry(src, findings: List[Finding]) -> None:
+    """Unbounded retry around an awaited network call: a `while True`
+    (or infinite `for`) whose try-body awaits the wire and whose
+    handler catches a transport fault back into the next iteration.
+    Against a dead peer this coroutine spins forever — cap it with
+    `for attempt in range(N)` or a deadline check that breaks/raises
+    (fleet/remote.py's in-dispatch retry is the canonical shape)."""
+    for loop in ast.walk(src.tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        if not _loop_unbounded(loop) or _deadline_guarded(loop):
+            continue
+        for node in _walk_loop_body(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            awaits_net = any(
+                isinstance(sub, ast.Await) and
+                isinstance(sub.value, ast.Call) and
+                dotted(sub.value.func).split(".")[-1] in _RETRY_NET_TAILS
+                for stmt in node.body for sub in ast.walk(stmt)
+            )
+            if not awaits_net:
+                continue
+            retries = next(
+                (h for h in node.handlers
+                 if (h.type is None or
+                     any(n in _RETRY_EXC_TAILS
+                         for n in _handler_type_names(h))) and
+                 _handler_reiterates(h)),
+                None)
+            if retries is None:
+                continue
+            findings.append(src.finding(
+                "conc-unbounded-retry", retries,
+                "transport fault caught back into an unbounded loop "
+                "around an awaited network call; a dead peer spins "
+                "this retry forever — bound it with an attempt cap "
+                "(for attempt in range(N)) or a deadline guard that "
+                "breaks/raises",
+            ))
+
+
 @register_family("concurrency")
 def check_concurrency(project: Project) -> List[Finding]:
     findings: List[Finding] = []
@@ -388,6 +526,9 @@ def check_concurrency(project: Project) -> List[Finding]:
 
     for src in project.in_dirs(*SERVE_ASYNC_SCOPE):
         _check_sock_in_loop(src, findings)
+
+    for src in project.in_dirs(*RETRY_SCOPE):
+        _check_unbounded_retry(src, findings)
 
     for src in project.in_dirs(*BLOCK_SCOPE):
         parents = _parents(src.tree)

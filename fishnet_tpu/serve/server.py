@@ -6,6 +6,8 @@ Stdlib only — `asyncio.start_server` plus a hand-rolled HTTP/1.1 layer
     POST /analyse    batch analysis  (protocol.py body shape)
     POST /bestmove   play-speed move requests
     GET  /healthz    JSON liveness/occupancy summary
+    GET  /fleet/members   fleet health table   (fleet front-ends only)
+    POST /fleet/members   runtime membership: add / drain / remove
 
 Every accepted request is stamped with a deadline (its own timeout_ms
 clamped by FISHNET_TPU_SERVE_TIMEOUT_MS), passes the admission
@@ -58,6 +60,7 @@ _REASONS = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    409: "Conflict",
     413: "Payload Too Large",
     429: "Too Many Requests",
     500: "Internal Server Error",
@@ -86,8 +89,12 @@ class ServeApp:
         drain_s: Optional[float] = None,
         logger: Optional[Logger] = None,
         registry: Optional[obs_metrics.MetricsRegistry] = None,
+        fleet=None,
     ):
         self.session = session
+        # the FleetCoordinator behind this front-end, when there is one:
+        # enables the /fleet/members runtime-membership admin surface
+        self.fleet = fleet
         self.logger = logger or Logger()
         if max_inflight is None:
             max_inflight = settings.get_int("FISHNET_TPU_SERVE_MAX_INFLIGHT")
@@ -271,6 +278,8 @@ class ServeApp:
                 return 405, {"error": "use GET"}, {}
             reqs = self.inflight.snapshot()
             return 200, {"inflight": len(reqs), "requests": reqs}, {}
+        if path == "/fleet/members":
+            return await self._fleet_members(method, body)
         kind = _ENDPOINTS.get(path)
         if kind is None:
             return 404, {"error": f"no such endpoint {path}"}, {}
@@ -289,6 +298,50 @@ class ServeApp:
         return await self._serve_request(
             sreq, upstream_trace=headers.get(TRACE_HEADER, "")
         )
+
+    async def _fleet_members(
+        self, method: str, body: bytes
+    ) -> Tuple[int, dict, Dict[str, str]]:
+        """Runtime membership (docs/fleet.md rolling restarts): GET is
+        the coordinator's health table; POST takes {"action": "add",
+        "spec": ...} | {"action": "drain"|"remove", "member": ...}.
+        State conflicts (undrained removal, duplicate add) answer 409."""
+        if self.fleet is None:
+            return 404, {"error": "not a fleet front-end"}, {}
+        if method == "GET":
+            return 200, self.fleet.health(), {}
+        if method != "POST":
+            return 405, {"error": "use GET or POST"}, {}
+        try:
+            obj = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return 400, {"error": "body is not valid JSON"}, {}
+        if not isinstance(obj, dict):
+            return 400, {"error": "body must be a JSON object"}, {}
+        action = obj.get("action")
+        try:
+            if action == "add":
+                row = await self.fleet.add_member(
+                    str(obj.get("spec") or "")
+                )
+                return 200, {"ok": True, "member": row}, {}
+            if action == "drain":
+                out = self.fleet.drain_member(
+                    str(obj.get("member") or "")
+                )
+                return 200, {"ok": True, **out}, {}
+            if action == "remove":
+                row = await self.fleet.remove_member(
+                    str(obj.get("member") or ""),
+                    force=bool(obj.get("force")),
+                )
+                return 200, {"ok": True, "member": row}, {}
+        except EngineError as e:
+            return 409, {"error": str(e)}, {}
+        return 400, {
+            "error": f"unknown action {action!r} "
+                     "(use add / drain / remove)"
+        }, {}
 
     async def _serve_request(
         self, sreq, upstream_trace: str = ""
@@ -433,7 +486,10 @@ async def run_serve(cfg) -> int:
             )
 
     session = EngineSession(engine, flavor=flavor)
-    app = ServeApp(session, logger=logger)
+    app = ServeApp(
+        session, logger=logger,
+        fleet=engine if getattr(cfg, "fleet", False) else None,
+    )
     bound_host, bound_port = await app.start(host, port)
     # the smoke client and bench parse this exact line to find an
     # ephemeral port (FISHNET_TPU_SERVE_PORT=0)

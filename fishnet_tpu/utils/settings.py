@@ -361,6 +361,54 @@ SETTINGS: Tuple[Setting, ...] = (
             "still applies underneath).",
     ),
     Setting(
+        name="FISHNET_TPU_FLEET_RETRY_MAX",
+        kind="int",
+        default="4",
+        doc="In-dispatch retry attempts for transient remote faults "
+            "(connect refused, timeout before the request was written) "
+            "before the dispatch escalates to a member-loss event "
+            "(fleet/faults.py taxonomy). Retries use jittered "
+            "exponential backoff bounded by the chunk's deadline slack.",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_COOLDOWN_MAX",
+        kind="int",
+        default="600",
+        doc="Cap in seconds on the fleet's escalating loss cooldown: "
+            "each consecutive loss doubles the member's cooldown from "
+            "FISHNET_TPU_FLEET_LOSS_WINDOW up to this bound, so a "
+            "permanently-dead member costs only periodic probes.",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_PROBATION",
+        kind="bool",
+        default="1",
+        doc="Probed readmission: after its cooldown a lost member "
+            "enters probation and must pass a healthz probe plus one "
+            "canary chunk before the planner gives it real work again. "
+            "0 restores blind readmission at cooldown expiry.",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_HEDGE",
+        kind="bool",
+        default="0",
+        doc="Hedged dispatch: when a dispatched sub-chunk's deadline "
+            "slack drops below FISHNET_TPU_FLEET_HEDGE_SLACK_MS and a "
+            "healthy member has free capacity, duplicate the unfinished "
+            "positions to it; first answer wins via the exactly-once "
+            "fingerprint ledger, the loser is discarded and counted. "
+            "Results are bit-identical with hedging on or off.",
+    ),
+    Setting(
+        name="FISHNET_TPU_FLEET_HEDGE_SLACK_MS",
+        kind="int",
+        default="1500",
+        doc="Deadline slack threshold for hedged dispatch: a sub-chunk "
+            "still unanswered when this many milliseconds remain before "
+            "its chunk deadline is duplicated to a free member (only "
+            "with FISHNET_TPU_FLEET_HEDGE=1).",
+    ),
+    Setting(
         name="FISHNET_TPU_AOT",
         kind="bool",
         default="1",

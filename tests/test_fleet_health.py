@@ -1,0 +1,614 @@
+"""Self-healing fleet tests (ISSUE 15): fault taxonomy, in-dispatch
+retry, probed readmission, hedged dispatch, runtime membership.
+
+All on CPU with the scriptable fake host / PyEngine / FlakyProxy — no
+JAX:
+
+- the fault table: connect-phase faults are transient, anything after
+  the request hit the wire is a loss, 429 is backpressure;
+- a transient fault inside the retry budget never becomes a loss event
+  (in-dispatch retry through a FlakyProxy refusal window);
+- the retry backoff is bounded by the dispatch deadline — a dead peer
+  costs bounded time, not retry_max * max_pause;
+- a 429 shed reroutes the sub-chunk to a free member with ZERO loss
+  events (satellite bugfix: typed MemberBusy carrying Retry-After);
+- probed readmission: a lost member re-enters only through healthz +
+  one canary chunk; a failed probe escalates the cooldown but is NOT
+  a loss event; cooldown escalation caps at cooldown_max;
+- hedged dispatch duplicates the straggler's unfinished positions,
+  first answer wins exactly-once, the counters tie out, and results
+  are bit-identical with hedging on or off;
+- runtime membership: drain completes in-flight work, remove/add cycle
+  a member with zero lost or re-searched positions (rolling restart),
+  and the /fleet/members HTTP admin surface drives all of it.
+"""
+import asyncio
+import json
+import sys
+import time
+
+import pytest
+
+from fishnet_tpu.client.backoff import RandomizedBackoff
+from fishnet_tpu.client.ipc import (
+    Chunk,
+    WorkPosition,
+    position_fingerprint,
+    response_to_wire,
+)
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.wire import AnalysisWork, EngineFlavor, NodeLimit
+from fishnet_tpu.engine.base import EngineError
+from fishnet_tpu.engine.fakehost import FAKE_CP, FlakyProxy
+from fishnet_tpu.engine.pyengine import PyEngine
+from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+from fishnet_tpu.fleet.faults import (
+    FAULT_BUSY,
+    FAULT_LOSS,
+    FAULT_TRANSIENT,
+    MemberBusy,
+    MemberFault,
+    classify,
+)
+from fishnet_tpu.fleet.member import make_local_member
+from fishnet_tpu.fleet.remote import HttpEngine
+from fishnet_tpu.obs.metrics import MetricsRegistry
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.subproc]
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def fake_cmd(script, state_path, hb=0.05, echo=None, extra=()):
+    cmd = [
+        sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+        "--script", json.dumps(script),
+        "--state", str(state_path),
+        "--hb-interval", str(hb),
+    ]
+    if echo is not None:
+        cmd += ["--echo", str(echo)]
+    return cmd + list(extra)
+
+
+def fake_member(name, script, tmp_path, echo=None, extra=()):
+    return make_local_member(
+        name,
+        host_cmd=fake_cmd(script, tmp_path / f"{name}.json",
+                          echo=echo, extra=extra),
+        logger=Logger(verbose=0),
+        hb_interval=0.05,
+        hb_timeout=1.0,
+        backoff=RandomizedBackoff(max_s=0.05),
+    )
+
+
+def make_chunk(n=4, ttl=30.0, moves=(), depth=1,
+               flavor=EngineFlavor.TPU, batch="healthjob"):
+    work = AnalysisWork(
+        id=batch,
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=ttl, depth=depth, multipv=None,
+    )
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=START, moves=list(moves))
+        for i in range(n)
+    ]
+    return Chunk(work=work, deadline=time.monotonic() + ttl,
+                 variant="standard", flavor=flavor, positions=positions)
+
+
+def comparable(res):
+    wire = response_to_wire(res)
+    return {k: wire[k]
+            for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+
+
+def read_echo(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def make_coord(members, **kw):
+    kw.setdefault("logger", Logger(verbose=0))
+    kw.setdefault("registry", MetricsRegistry())
+    return FleetCoordinator(members, **kw)
+
+
+async def busy_server(retry_after=0.25):
+    """One-trick serve stand-in: every request is answered 429 with a
+    Retry-After hint — the admission controller in full shed."""
+
+    async def handle(reader, writer):
+        head = await reader.readuntil(b"\r\n\r\n")
+        length = 0
+        for line in head.decode("latin-1").split("\r\n"):
+            if line.lower().startswith("content-length:"):
+                length = int(line.split(":", 1)[1])
+        if length:
+            await reader.readexactly(length)
+        body = json.dumps({"error": "shed", "retry_after": retry_after})
+        writer.write(
+            (
+                "HTTP/1.1 429 Too Many Requests\r\n"
+                "Content-Type: application/json\r\n"
+                f"Retry-After: {max(int(retry_after), 1)}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n" + body
+            ).encode("latin-1")
+        )
+        await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
+# ------------------------------------------------------------ fault table
+
+
+def test_fault_classification_table():
+    """The taxonomy that decides retry-vs-loss: connect-phase transport
+    faults are transient (safe to retry — the request never reached the
+    peer); anything after the request hit the wire is a loss (the peer
+    may be mid-search: a blind retry would double-search); unknown
+    exceptions default to loss (fail safe, never spin)."""
+    table = [
+        (ConnectionRefusedError("refused"), False, FAULT_TRANSIENT),
+        (ConnectionResetError("reset"), False, FAULT_TRANSIENT),
+        (OSError("no route"), False, FAULT_TRANSIENT),
+        (asyncio.TimeoutError(), False, FAULT_TRANSIENT),
+        (asyncio.IncompleteReadError(b"", 10), False, FAULT_TRANSIENT),
+        # the same faults after the request was written: loss
+        (ConnectionResetError("reset"), True, FAULT_LOSS),
+        (asyncio.TimeoutError(), True, FAULT_LOSS),
+        (OSError("broken pipe"), True, FAULT_LOSS),
+        # non-transport failures never retry
+        (ValueError("garbage"), False, FAULT_LOSS),
+    ]
+    for exc, wrote, want in table:
+        assert classify(exc, wrote=wrote) == want, (exc, wrote)
+
+    assert MemberFault("x").kind == FAULT_LOSS
+    assert not MemberFault("x").retriable
+    assert MemberFault("x", kind=FAULT_TRANSIENT).retriable
+    busy = MemberBusy("shed", retry_after=2.5)
+    assert busy.kind == FAULT_BUSY
+    assert busy.retry_after == 2.5
+    assert not busy.retriable  # backpressure is rerouted, not redialed
+    assert MemberBusy("shed", retry_after=-3.0).retry_after == 0.0
+    assert isinstance(busy, EngineError)  # coordinator-visible hierarchy
+
+
+# ------------------------------------------------------ in-dispatch retry
+
+
+def test_transient_fault_retried_inside_dispatch():
+    """A connect-refused window shorter than the retry budget is
+    invisible above the dispatch: the chunk answers normally, the
+    engine counts retries, and no EngineError ever surfaces."""
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.serve.server import ServeApp
+
+    async def scenario():
+        app = ServeApp(
+            EngineSession(PyEngine(max_depth=1),
+                          flavor=EngineFlavor.OFFICIAL),
+            registry=MetricsRegistry(),
+            logger=Logger(verbose=0),
+        )
+        host, port = await app.start("127.0.0.1", 0)
+        proxy = FlakyProxy(host, port)
+        phost, pport = await proxy.start()
+        engine = HttpEngine(f"http://{phost}:{pport}", retry_max=8)
+        try:
+            await proxy.set_fault("refuse-for:0.3")
+            chunk = make_chunk(n=2, ttl=20.0, depth=1,
+                               flavor=EngineFlavor.OFFICIAL)
+            responses = await engine.go_multiple(chunk)
+            assert [r.position_index for r in responses] == [0, 1]
+            assert engine.retries >= 1  # the refusal window was real
+        finally:
+            await engine.close()
+            await proxy.close()
+            await app.drain_and_stop()
+
+    asyncio.run(scenario())
+
+
+def test_retry_backoff_bounded_by_deadline():
+    """Against a permanently-refusing endpoint the retry loop must give
+    up when the dispatch budget runs out — not after retry_max maximum
+    pauses. 50 nominal attempts against a 0.5s budget returns in ~0.5s
+    with a loss-kind fault chaining the last transient one."""
+
+    async def scenario():
+        engine = HttpEngine("http://127.0.0.1:1", timeout_s=0.5,
+                            retry_max=50)
+        t0 = time.monotonic()
+        with pytest.raises(MemberFault) as exc:
+            await engine.go_multiple(make_chunk(n=1, ttl=30.0))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0  # deadline-bounded, not 50 * max-pause
+        assert exc.value.kind == FAULT_LOSS  # escalated past the budget
+        assert isinstance(exc.value.__cause__, MemberFault)
+        assert exc.value.__cause__.kind == FAULT_TRANSIENT
+
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------------- 429 backpressure
+
+
+def test_429_is_typed_backpressure_not_loss(tmp_path):
+    """Satellite bugfix: a member shedding with 429 raises MemberBusy
+    carrying the Retry-After hint, and the coordinator reroutes the
+    sub-chunk to a free member with ZERO loss events — designed
+    backpressure must not look like member death."""
+
+    async def scenario():
+        server, port = await busy_server(retry_after=0.25)
+        busy = FleetMember(
+            name="busy",
+            engine=HttpEngine(f"http://127.0.0.1:{port}", retry_max=0),
+            kind="remote",
+        )
+        # raw engine surface first: the typed fault and its hint
+        with pytest.raises(MemberBusy) as exc:
+            await busy.engine.go_multiple(make_chunk(n=1))
+        assert exc.value.retry_after == 0.25
+
+        coord = make_coord(
+            [busy, fake_member("m1", {"chunks": ["ok", "ok"]}, tmp_path)],
+            loss_window=5.0,
+        )
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=2))
+            assert [r.position_index for r in responses] == [0, 1]
+            assert all(r.scores.best().value == FAKE_CP
+                       for r in responses)
+        finally:
+            await coord.close()
+            server.close()
+            await server.wait_closed()
+
+        assert coord.stats.losses == 0  # backpressure, not death
+        assert coord.loss_log == []
+        assert coord.stats.busy_reroutes >= 1
+        assert busy.consecutive_losses == 0
+        assert not busy.probation  # busy members skip the gauntlet
+
+    asyncio.run(scenario())
+
+
+# --------------------------------------------------- probation / readmission
+
+
+def test_probation_canary_readmission(tmp_path):
+    """The readmission gauntlet: a lost member sits out its cooldown,
+    then must pass healthz + one canary chunk before the planner sees
+    it again. The canary is synthetic — no queue position ever rides
+    probation — and a served sub-chunk resets the flap counter."""
+
+    async def scenario():
+        m0 = fake_member("m0", {"chunks": ["die-after:1", "ok", "ok"]},
+                         tmp_path)
+        m1 = fake_member("m1", {"chunks": ["ok", "ok", "ok"]}, tmp_path)
+        coord = make_coord([m0, m1], loss_window=0.05, cooldown_max=10.0)
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=4))
+            assert len(responses) == 4
+            assert coord.stats.losses == 1
+            assert m0.probation and not m0.available()
+            assert m0.state() in ("cooldown", "probation")
+
+            await asyncio.sleep(0.1)  # cooldown expires -> probe due
+            assert m0.state() == "probation"
+            await coord.probe_members()
+            assert not m0.probation
+            assert m0.available()
+            assert m0.state() == "eligible"
+            assert coord.stats.probes == 1
+            assert coord.stats.canaries_ok == 1
+            assert coord.stats.readmissions == 1
+            assert m0.canaries_ok == 1
+            assert not m0.acked  # the canary left no ledger residue
+
+            # back in rotation: a real chunk lands on it and resets the
+            # flap counter
+            responses = await coord.go_multiple(
+                make_chunk(n=2, batch="healthjob2"))
+            assert len(responses) == 2
+            assert m0.consecutive_losses == 0
+            assert coord.stats.losses == 1  # no new losses
+        finally:
+            await coord.close()
+
+    asyncio.run(scenario())
+
+
+def test_failed_probe_escalates_cooldown_not_loss(tmp_path):
+    """A permanently-dead member costs probes, never work: the failed
+    probe escalates its cooldown (exponentially, toward cooldown_max)
+    and counts probe_failures — but is NOT a loss event, because no
+    queue position was at risk."""
+
+    async def scenario():
+        dead = FleetMember(
+            name="dead",
+            engine=HttpEngine("http://127.0.0.1:1", retry_max=1,
+                              timeout_s=1.0),
+            kind="remote",
+        )
+        coord = make_coord(
+            [dead, fake_member("m1", {"chunks": ["ok"]}, tmp_path)],
+            loss_window=0.05, cooldown_max=10.0,
+        )
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=2))
+            assert len(responses) == 2
+            assert coord.stats.losses == 1
+            losses_before = dead.consecutive_losses
+
+            await asyncio.sleep(0.1)
+            await coord.probe_members()
+            assert coord.stats.probes == 1
+            assert coord.stats.probe_failures == 1
+            assert coord.stats.losses == 1  # unchanged: not a loss event
+            assert coord.stats.readmissions == 0
+            assert dead.probation  # still outside the planner
+            assert not dead.available()
+            assert dead.consecutive_losses == losses_before + 1
+        finally:
+            await coord.close()
+
+    asyncio.run(scenario())
+
+
+def test_cooldown_escalates_exponentially_and_caps():
+    """Flap damping: each consecutive loss doubles the cooldown until
+    cooldown_max; a flapping member converges to probing at the cap
+    instead of thrashing the planner."""
+
+    async def scenario():
+        member = FleetMember(name="flappy", engine=PyEngine(max_depth=1))
+        coord = make_coord(
+            [member, FleetMember(name="ok", engine=PyEngine(max_depth=1))],
+            loss_window=0.5, cooldown_max=4.0,
+        )
+        try:
+            seen = []
+            for _ in range(5):
+                t0 = time.monotonic()
+                coord._note_loss(member, "test", [], {})
+                seen.append(member.down_until - t0)
+            # 0.5, 1, 2, 4, 4 — doubling, then the cap
+            for got, want in zip(seen, [0.5, 1.0, 2.0, 4.0, 4.0]):
+                assert abs(got - want) < 0.1, seen
+            assert member.probation
+            assert coord.stats.losses == 5
+        finally:
+            await coord.close()
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------------------ hedging
+
+
+def test_hedged_dispatch_first_answer_wins(tmp_path):
+    """A straggling member's unfinished positions are duplicated to the
+    free member once deadline slack runs low; the first answer wins
+    through the exactly-once ledger, the loser is discarded and
+    counted, and the answers are bit-identical to a hedge-off run."""
+    echo_fast = tmp_path / "fast.jsonl"
+
+    def members():
+        return [
+            fake_member("slow", {"chunks": ["ok", "ok"]}, tmp_path,
+                        extra=["--latency-ms", "800"]),
+            fake_member("fast", {"chunks": ["ok", "ok"]}, tmp_path,
+                        echo=echo_fast),
+        ]
+
+    async def run(hedge):
+        registry = MetricsRegistry()
+        coord = make_coord(
+            members(), registry=registry, loss_window=5.0,
+            hedge=hedge, hedge_slack_ms=3500,
+        )
+        try:
+            await coord.start()
+            responses = await coord.go_multiple(make_chunk(n=2, ttl=4.0))
+            assert [r.position_index for r in responses] == [0, 1]
+        finally:
+            await coord.close()
+        return coord, registry, [comparable(r) for r in responses]
+
+    async def scenario():
+        hedged, registry, on = await run(hedge=True)
+        assert hedged.stats.hedges >= 1
+        assert hedged.stats.hedge_wins >= 1
+        assert hedged.stats.losses == 0  # the straggler was slow, not dead
+        snap = registry.snapshot()
+        assert snap["fleet_hedges_total"] == hedged.stats.hedges
+        assert snap["fleet_hedge_wins_total"] == hedged.stats.hedge_wins
+        # the fast member served its own sub-chunk AND the hedge copy
+        fast_gos = [r for r in read_echo(echo_fast) if r["t"] == "go"]
+        assert len(fast_gos) == 2
+
+        echo_fast.unlink()
+        plain, _, off = await run(hedge=False)
+        assert plain.stats.hedges == 0
+        assert on == off  # bit-identical with hedging on or off
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------- runtime membership
+
+
+def test_rolling_restart_drain_remove_readd(tmp_path):
+    """The docs/fleet.md rolling restart: drain a member mid-chunk (its
+    in-flight work finishes, nothing new lands on it), remove it once
+    drained, re-add a replacement — zero lost and zero re-searched
+    positions across the whole cycle."""
+    echos = {n: tmp_path / f"{n}.jsonl" for n in ("m0", "m1", "r0")}
+
+    async def scenario():
+        coord = make_coord(
+            [
+                fake_member("m0", {"chunks": ["ok", "ok"]}, tmp_path,
+                            echo=echos["m0"],
+                            extra=["--latency-ms", "300"]),
+                fake_member("m1", {"chunks": ["ok", "ok", "ok"]},
+                            tmp_path, echo=echos["m1"]),
+            ],
+            loss_window=5.0,
+            local_factory=lambda name: fake_member(
+                name, {"chunks": ["ok", "ok"]}, tmp_path,
+                echo=echos["r0"]),
+        )
+        try:
+            await coord.start()
+            # a chunk is in flight on m0 when the drain begins
+            first = asyncio.ensure_future(
+                coord.go_multiple(make_chunk(n=2, batch="job-a")))
+            await asyncio.sleep(0.1)
+            out = coord.drain_member("m0")
+            assert out["drained"] is False  # still holds in-flight work
+            assert coord._member("m0").state() == "draining"
+            # draining refuses new work but finishes what it holds
+            with pytest.raises(EngineError):
+                await coord.remove_member("m0")
+            responses = await first
+            assert [r.position_index for r in responses] == [0, 1]
+            assert coord.drained("m0")
+
+            removed = await coord.remove_member("m0")
+            assert removed["name"] == "m0"
+            assert [m.name for m in coord.members] == ["m1"]
+
+            # the shrunken fleet still serves
+            mid = await coord.go_multiple(make_chunk(n=1, batch="job-b"))
+            assert len(mid) == 1
+
+            added = await coord.add_member("local")
+            assert added["name"] == "local0"
+            assert len(coord.members) == 2
+            last = await coord.go_multiple(make_chunk(n=2, batch="job-c"))
+            assert [r.position_index for r in last] == [0, 1]
+        finally:
+            await coord.close()
+
+        assert coord.stats.losses == 0
+        assert coord.stats.drains == 1
+        assert coord.stats.members_removed == 1
+        assert coord.stats.members_added == 1
+        # zero re-searched positions: the members collectively received
+        # exactly the 5 positions the three chunks submitted
+        gos = [g for path in echos.values() if path.exists()
+               for g in read_echo(path) if g["t"] == "go"]
+        assert sum(g["positions"] for g in gos) == 5
+        # and the replacement actually joined the rotation
+        assert any(g["positions"] for g in read_echo(echos["r0"])
+                   if g["t"] == "go")
+
+    asyncio.run(scenario())
+
+
+def test_http_admin_surface(tmp_path):
+    """GET /fleet/members is the health table; POST add/drain/remove is
+    how fleet-ctl (and a rolling restart) drives membership. Non-fleet
+    front-ends 404 the path; validation errors come back 400/409."""
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.serve.server import ServeApp
+
+    async def _http(host, port, method, path, obj=None):
+        reader, writer = await asyncio.open_connection(host, port)
+        body = json.dumps(obj).encode("utf-8") if obj is not None else b""
+        head = (
+            f"{method} {path} HTTP/1.1\r\nHost: {host}\r\n"
+            f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+        )
+        writer.write(head.encode("latin-1") + body)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        await writer.wait_closed()
+        head_raw, _, payload = raw.partition(b"\r\n\r\n")
+        status = int(head_raw.decode("latin-1").split("\r\n")[0].split()[1])
+        return status, json.loads(payload) if payload else {}
+
+    async def scenario():
+        coord = make_coord(
+            [FleetMember(name="py0", engine=PyEngine(max_depth=1)),
+             FleetMember(name="py1", engine=PyEngine(max_depth=1))],
+            loss_window=5.0,
+            local_factory=lambda name: FleetMember(
+                name=name, engine=PyEngine(max_depth=1)),
+        )
+        app = ServeApp(
+            EngineSession(PyEngine(max_depth=1),
+                          flavor=EngineFlavor.OFFICIAL),
+            registry=MetricsRegistry(),
+            logger=Logger(verbose=0),
+            fleet=coord,
+        )
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            status, table = await _http(host, port, "GET", "/fleet/members")
+            assert status == 200
+            assert [m["name"] for m in table["members"]] == ["py0", "py1"]
+            assert table["members_live"] == 2
+            assert all(m["state"] == "eligible" for m in table["members"])
+
+            status, row = await _http(
+                host, port, "POST", "/fleet/members",
+                {"action": "add", "spec": "local"})
+            assert status == 200
+            assert row["ok"] and row["member"]["name"] == "local0"
+
+            status, out = await _http(
+                host, port, "POST", "/fleet/members",
+                {"action": "drain", "member": "local0"})
+            assert status == 200 and out["drained"] is True
+
+            status, row = await _http(
+                host, port, "POST", "/fleet/members",
+                {"action": "remove", "member": "local0"})
+            assert status == 200 and row["member"]["name"] == "local0"
+            status, table = await _http(host, port, "GET", "/fleet/members")
+            assert [m["name"] for m in table["members"]] == ["py0", "py1"]
+
+            # validation surfaces as HTTP codes, not connection drops
+            status, _ = await _http(
+                host, port, "POST", "/fleet/members",
+                {"action": "remove", "member": "nope"})
+            assert status == 409
+            status, _ = await _http(
+                host, port, "POST", "/fleet/members", {"action": "wat"})
+            assert status == 400
+        finally:
+            await app.drain_and_stop()
+            await coord.close()
+
+        # a plain (non-fleet) front-end does not expose the surface
+        app2 = ServeApp(
+            EngineSession(PyEngine(max_depth=1),
+                          flavor=EngineFlavor.OFFICIAL),
+            registry=MetricsRegistry(),
+            logger=Logger(verbose=0),
+        )
+        host2, port2 = await app2.start("127.0.0.1", 0)
+        try:
+            status, _ = await _http(host2, port2, "GET", "/fleet/members")
+            assert status == 404
+        finally:
+            await app2.drain_and_stop()
+
+    asyncio.run(scenario())

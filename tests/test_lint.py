@@ -427,6 +427,128 @@ async def handler():
     assert by_rule(result.findings, "conc-sock-in-loop") == []
 
 
+RETRY_BAD = '''
+import asyncio
+
+
+async def dial(host):
+    while True:
+        try:
+            r, w = await asyncio.open_connection(host, 80)
+            return r, w
+        except OSError:
+            await asyncio.sleep(0.1)
+'''
+
+
+def _retry_findings(tmp_path, rel, src):
+    project = make_project(tmp_path, {rel: src})
+    result = run_lint(project, only_families={"concurrency"})
+    return by_rule(result.findings, "conc-unbounded-retry")
+
+
+def test_unbounded_retry_flagged(tmp_path):
+    flagged = _retry_findings(
+        tmp_path, "fishnet_tpu/fleet/remote.py", RETRY_BAD)
+    assert len(flagged) == 1
+
+
+def test_unbounded_retry_out_of_scope(tmp_path):
+    # same shape outside fleet/serve/client: not this rule's business
+    assert _retry_findings(
+        tmp_path, "fishnet_tpu/obs/push.py", RETRY_BAD) == []
+
+
+def test_retry_attempt_cap_is_clean(tmp_path):
+    src = '''
+import asyncio
+
+
+async def dial(host, retry_max):
+    for attempt in range(retry_max):
+        try:
+            return await asyncio.open_connection(host, 80)
+        except OSError:
+            await asyncio.sleep(0.1)
+    raise ConnectionError("out of attempts")
+'''
+    assert _retry_findings(
+        tmp_path, "fishnet_tpu/fleet/remote.py", src) == []
+
+
+def test_retry_deadline_guard_is_clean(tmp_path):
+    src = '''
+import asyncio
+import time
+
+
+async def dial(host, deadline):
+    while True:
+        if time.monotonic() >= deadline:
+            raise ConnectionError("deadline exhausted")
+        try:
+            return await asyncio.open_connection(host, 80)
+        except OSError:
+            await asyncio.sleep(0.1)
+'''
+    assert _retry_findings(
+        tmp_path, "fishnet_tpu/fleet/remote.py", src) == []
+
+
+def test_retry_reraising_handler_is_clean(tmp_path):
+    # the handler ends the loop: no second lap, no retry
+    src = '''
+import asyncio
+
+
+async def dial(host):
+    while True:
+        try:
+            return await asyncio.open_connection(host, 80)
+        except OSError as e:
+            raise ConnectionError("no retry") from e
+'''
+    assert _retry_findings(
+        tmp_path, "fishnet_tpu/fleet/remote.py", src) == []
+
+
+def test_retry_application_error_loop_is_clean(tmp_path):
+    # the work queue's long-poll shape: protocol-flow exception, and
+    # the awaited call is not in the network-tail set
+    src = '''
+class ApiError(Exception):
+    pass
+
+
+async def pull(api):
+    while True:
+        try:
+            return await api.acquire(slow=True)
+        except ApiError:
+            continue
+'''
+    assert _retry_findings(
+        tmp_path, "fishnet_tpu/client/queue.py", src) == []
+
+
+def test_retry_for_over_count_flagged(tmp_path):
+    src = '''
+import asyncio
+import itertools
+
+
+async def dial(host):
+    for _ in itertools.count():
+        try:
+            return await asyncio.open_connection(host, 80)
+        except ConnectionError:
+            await asyncio.sleep(0.1)
+'''
+    flagged = _retry_findings(
+        tmp_path, "fishnet_tpu/serve/server.py", src)
+    assert len(flagged) == 1
+
+
 def test_except_rules(tmp_path):
     src = '''
 def f(log):

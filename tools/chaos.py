@@ -31,6 +31,17 @@ recorded, and the merged flight-recorder dump must carry spans from all
 three member processes on one clock-synced timeline despite their
 deliberately skewed clocks.
 
+`--scenario fleet-flap` and `--scenario fleet-straggler-hedge` are the
+self-healing acceptance gates (ISSUE 15). fleet-flap puts a remote
+member behind a FlakyProxy: a connection-refused window shorter than
+the in-dispatch retry budget must cost ZERO loss events, a longer one
+exactly ONE, and the member must readmit through the probation
+gauntlet (healthz + canary) once the proxy recovers — all with
+bit-identical answers. fleet-straggler-hedge runs a 3-member fleet
+with one 400ms straggler, hedge off then on: hedging must cut p99
+chunk latency, keep every position exactly-once, count its wins in
+fleet_hedges_total/fleet_hedge_wins_total, and stay bit-identical.
+
 `--scenario request-trace` is the request-tracing acceptance gate
 (ISSUE 14): a request POSTed to /analyse on a ServeApp fronting that
 same 3-member dying fleet must leave ONE merged Chrome trace linking
@@ -494,6 +505,299 @@ async def fleet_scenario(args) -> int:
     return 0
 
 
+async def fleet_flap_scenario(args) -> int:
+    """Self-healing acceptance gate (ISSUE 15), flap half. A remote
+    member sits behind a FlakyProxy TCP shim:
+
+    - a refusal window SHORTER than the in-dispatch retry budget must
+      produce ZERO loss events (the taxonomy calls connect-refused
+      transient; the bounded backoff rides it out);
+    - a refusal window LONGER than the budget must cost exactly ONE
+      loss event, with the stranded positions rerouted to the survivor;
+    - once the proxy recovers, the member must readmit through the
+      probation gauntlet (healthz + one canary chunk) and serve again;
+    - every chunk's answers must be bit-identical to the same chunks
+      run directly on the member engine (PyEngine)."""
+    from fishnet_tpu.client.ipc import response_to_wire
+    from fishnet_tpu.engine.fakehost import FlakyProxy
+    from fishnet_tpu.engine.pyengine import PyEngine
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+    from fishnet_tpu.fleet.remote import HttpEngine
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+    from fishnet_tpu.serve.server import ServeApp
+
+    problems = []
+    n = 4
+
+    def flap_chunk(i):
+        work = AnalysisWork(
+            id=f"flap{i:03d}",
+            nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+            timeout_s=20.0, depth=2, multipv=None,
+        )
+        return Chunk(
+            work=work, deadline=time.monotonic() + 20.0,
+            variant="standard", flavor=EngineFlavor.OFFICIAL,
+            positions=[
+                WorkPosition(work=work, position_index=i, url=None,
+                             skip=False, root_fen=START, moves=[])
+                for i in range(n)
+            ],
+        )
+
+    def comparable(res):
+        wire = response_to_wire(res)
+        return {k: wire[k]
+                for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+
+    # ground truth: the same three chunks straight through the engine
+    direct = []
+    for i in range(3):
+        direct.append([
+            comparable(r)
+            for r in await PyEngine(max_depth=2).go_multiple(flap_chunk(i))
+        ])
+
+    app = ServeApp(
+        EngineSession(PyEngine(max_depth=2), flavor=EngineFlavor.OFFICIAL),
+        registry=MetricsRegistry(), logger=Logger(verbose=0),
+    )
+    host, port = await app.start("127.0.0.1", 0)
+    proxy = FlakyProxy(host, port)
+    phost, pport = await proxy.start()
+    remote = FleetMember(
+        name="proxy",
+        engine=HttpEngine(f"http://{phost}:{pport}", retry_max=4),
+        kind="remote",
+    )
+    coord = FleetCoordinator(
+        [remote, FleetMember(name="cpu0", engine=PyEngine(max_depth=2))],
+        logger=Logger(verbose=2), registry=MetricsRegistry(),
+        loss_window=0.3, redispatch_max=3,
+    )
+    fleet_runs = []
+    try:
+        print("== flap phase 1: refusal shorter than the retry budget ==")
+        await proxy.set_fault("refuse-for:0.2")
+        responses = await coord.go_multiple(flap_chunk(0))
+        _check_exactly_once(responses, n, problems, "flap-transient")
+        fleet_runs.append([comparable(r) for r in responses])
+        if coord.stats.losses != 0:
+            problems.append(
+                "flap-transient: a refusal shorter than the retry budget "
+                f"became {coord.stats.losses} loss event(s) — the "
+                "taxonomy must retry connect-phase faults in-dispatch"
+            )
+        if remote.engine.retries < 1:
+            problems.append(
+                "flap-transient: the dispatch never retried "
+                "(retries=0) — the refusal window was not exercised"
+            )
+
+        print("== flap phase 2: refusal longer than the retry budget ==")
+        await proxy.wait_recovered()
+        await proxy.set_fault("refuse-for:1.5")
+        responses = await coord.go_multiple(flap_chunk(1))
+        _check_exactly_once(responses, n, problems, "flap-loss")
+        fleet_runs.append([comparable(r) for r in responses])
+        if coord.stats.losses != 1 or len(coord.loss_log) != 1:
+            problems.append(
+                "flap-loss: expected exactly one loss event, got "
+                f"losses={coord.stats.losses} log={len(coord.loss_log)}"
+            )
+        if coord.loss_log and coord.loss_log[0].member != "proxy":
+            problems.append(
+                f"flap-loss: the loss names {coord.loss_log[0].member!r},"
+                " expected the proxied member"
+            )
+        if not remote.probation:
+            problems.append(
+                "flap-loss: the lost member skipped probation — "
+                "readmission must pass through the gauntlet"
+            )
+
+        print("== flap phase 3: probed readmission (healthz + canary) ==")
+        await proxy.wait_recovered()
+        await asyncio.sleep(0.4)  # sit out the escalated cooldown
+        served_before = remote.dispatched_positions
+        await coord.probe_members()
+        if coord.stats.readmissions != 1 or coord.stats.canaries_ok != 1:
+            problems.append(
+                "flap-readmit: expected 1 readmission through 1 canary, "
+                f"got readmissions={coord.stats.readmissions} "
+                f"canaries_ok={coord.stats.canaries_ok} "
+                f"probe_failures={coord.stats.probe_failures}"
+            )
+        if not remote.available() or remote.probation:
+            problems.append(
+                f"flap-readmit: member state {remote.state()!r} after a "
+                "successful probe — expected eligible"
+            )
+        responses = await coord.go_multiple(flap_chunk(2))
+        _check_exactly_once(responses, n, problems, "flap-readmit")
+        fleet_runs.append([comparable(r) for r in responses])
+        if remote.dispatched_positions <= served_before:
+            problems.append(
+                "flap-readmit: the readmitted member was never planned "
+                "work again"
+            )
+        if coord.stats.losses != 1:
+            problems.append(
+                "flap-readmit: losses moved after readmission "
+                f"({coord.stats.losses}) — the canary/chunk flapped"
+            )
+        for phase, (got, want) in enumerate(zip(fleet_runs, direct)):
+            if got != want:
+                problems.append(
+                    f"flap phase {phase + 1}: answers are not "
+                    "bit-identical to the direct engine run"
+                )
+    except EngineError as e:
+        problems.append(f"fleet-flap: chunk failed outright: {e}")
+    finally:
+        print(f"fleet stats: {coord.stats}")
+        await coord.close()
+        await proxy.close()
+        await app.drain_and_stop()
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos fleet-flap::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos fleet-flap: zero-loss transient retry, one-loss flap, "
+          "probed readmission, bit-identical answers verified")
+    return 0
+
+
+async def fleet_hedge_scenario(args) -> int:
+    """Self-healing acceptance gate (ISSUE 15), hedging half. Three
+    fakehost members, one a 400ms straggler. With FISHNET_TPU_FLEET_HEDGE
+    semantics on, the straggler's position is duplicated to a free
+    member once deadline slack runs low and the first answer wins:
+    tail latency must drop measurably vs the hedge-off run, every
+    position must answer exactly once, the hedge counters must tie out
+    in the metrics registry, and the answers must be bit-identical
+    with hedging on or off."""
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+
+    problems = []
+    n, rounds = 3, 5
+
+    async def run(hedge, tmp):
+        def member(name, extra=()):
+            return make_local_member(
+                name,
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", json.dumps({"chunks": ["ok"]}),
+                    "--state", f"{tmp}/{name}.json",
+                    "--hb-interval", "0.05",
+                ] + list(extra),
+                logger=Logger(verbose=0),
+                hb_interval=0.05, hb_timeout=1.0,
+                backoff=RandomizedBackoff(max_s=0.05),
+            )
+
+        registry = MetricsRegistry()
+        coord = FleetCoordinator(
+            [
+                member("straggler", extra=["--latency-ms", "400"]),
+                member("f1"),
+                member("f2"),
+            ],
+            logger=Logger(verbose=0), registry=registry,
+            loss_window=5.0, hedge=hedge, hedge_slack_ms=1800,
+        )
+        latencies, answers = [], []
+        try:
+            await coord.start()
+            # warm round: absorb process spawn cost outside the timing
+            # (ttl 10 puts the hedge trigger far past completion)
+            await coord.go_multiple(make_chunk(900, 10.0, n))
+            for i in range(rounds):
+                chunk = make_chunk(901 + i, 2.0, n)
+                t0 = time.monotonic()
+                responses = await coord.go_multiple(chunk)
+                latencies.append(time.monotonic() - t0)
+                _check_exactly_once(
+                    responses, n, problems,
+                    f"straggler-hedge[hedge={hedge}] round {i}",
+                )
+                answers.append([
+                    (r.position_index, r.scores.best().value)
+                    for r in responses
+                ])
+            snap = registry.snapshot()
+        finally:
+            await coord.close()
+        return latencies, answers, coord.stats, snap
+
+    with tempfile.TemporaryDirectory(prefix="chaos-hedge-") as tmp:
+        print("== straggler fleet, hedge OFF ==")
+        lat_off, ans_off, stats_off, _ = await run(False, tmp)
+        print(f"   per-chunk latency: "
+              f"{' '.join(f'{v * 1000:.0f}ms' for v in lat_off)}")
+        print("== straggler fleet, hedge ON ==")
+        lat_on, ans_on, stats_on, snap_on = await run(True, tmp)
+        print(f"   per-chunk latency: "
+              f"{' '.join(f'{v * 1000:.0f}ms' for v in lat_on)}")
+
+    p99_off, p99_on = max(lat_off), max(lat_on)
+    print(f"\np99: off={p99_off * 1000:.0f}ms on={p99_on * 1000:.0f}ms  "
+          f"hedges={stats_on.hedges} wins={stats_on.hedge_wins}")
+    if ans_on != ans_off:
+        problems.append(
+            "straggler-hedge: answers differ between hedge on and off — "
+            "hedging must be bit-identical"
+        )
+    if stats_off.hedges != 0:
+        problems.append(
+            f"straggler-hedge: hedge-off run hedged {stats_off.hedges} "
+            "position(s)"
+        )
+    if stats_on.hedges < 1 or stats_on.hedge_wins < 1:
+        problems.append(
+            "straggler-hedge: expected at least one hedge and one hedge "
+            f"win, got hedges={stats_on.hedges} "
+            f"wins={stats_on.hedge_wins}"
+        )
+    if stats_on.losses or stats_off.losses:
+        problems.append(
+            "straggler-hedge: a slow member was treated as dead "
+            f"(losses on={stats_on.losses} off={stats_off.losses})"
+        )
+    if snap_on.get("fleet_hedges_total") != stats_on.hedges or \
+            snap_on.get("fleet_hedge_wins_total") != stats_on.hedge_wins:
+        problems.append(
+            "straggler-hedge: fleet_hedges_total/fleet_hedge_wins_total "
+            "do not tie out with the coordinator ledger"
+        )
+    if not p99_on < p99_off:
+        problems.append(
+            f"straggler-hedge: hedging did not cut p99 chunk latency "
+            f"({p99_on * 1000:.0f}ms vs {p99_off * 1000:.0f}ms)"
+        )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos fleet-straggler-hedge::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos fleet-straggler-hedge: first-answer-wins hedging cut the "
+          "tail, exactly-once and bit-identity verified")
+    return 0
+
+
 async def trace_smoke(args) -> int:
     """CI flight-recorder smoke (ISSUE 10): a chaos-induced child death
     with tracing on must leave a merged supervisor+host dump that loads
@@ -883,7 +1187,8 @@ def main(argv=None) -> int:
     p.add_argument("--breaker-threshold", type=int, default=3)
     p.add_argument("--probe-interval", type=float, default=5.0)
     p.add_argument("--scenario", nargs="?", const="ladder", default=None,
-                   choices=["ladder", "fleet-member-loss", "request-trace"],
+                   choices=["ladder", "fleet-member-loss", "request-trace",
+                            "fleet-flap", "fleet-straggler-hedge"],
                    help="run an acceptance scenario and exit non-zero on "
                         "any delivery violation: `ladder` (default when "
                         "the flag is bare) is the session-recovery "
@@ -906,6 +1211,10 @@ def main(argv=None) -> int:
         return asyncio.run(scenario(args))
     if args.scenario == "fleet-member-loss":
         return asyncio.run(fleet_scenario(args))
+    if args.scenario == "fleet-flap":
+        return asyncio.run(fleet_flap_scenario(args))
+    if args.scenario == "fleet-straggler-hedge":
+        return asyncio.run(fleet_hedge_scenario(args))
     if args.scenario == "request-trace":
         return asyncio.run(request_trace_scenario(args))
     if args.trace_smoke:
