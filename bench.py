@@ -1137,6 +1137,167 @@ def run_fleet_tail_stage(timeout: float) -> dict | None:
     }
 
 
+def run_autoscale_flash_stage(timeout: float) -> dict | None:
+    """Elastic-capacity row (ISSUE 16): the identical open-loop flash
+    crowd (tools/loadgen.py, 10x base rate, fixed seed) fired at a
+    ServeApp fronting a one-member-floor fakehost fleet, autoscaler off
+    vs on. The off run shows what a fixed floor does under a burst
+    (queue growth, SLO deadline misses, sheds); the on run must show a
+    strictly lower miss rate, the member count rising during the burst
+    and returning to the floor afterwards, and at most one up/down
+    reversal (the hysteresis asymmetry). Answers stay bit-identical —
+    the autoscaler only changes membership, never dispatch planning
+    (tests/test_autoscaler.py owns that assertion). CPU-only, no JAX.
+
+    Knobs: BENCH_AUTOSCALE=0 skips; BENCH_AUTOSCALE_RPS base rate
+    (default 2); BENCH_AUTOSCALE_LATENCY_MS member service latency
+    (default 80)."""
+    import asyncio
+
+    from fishnet_tpu.client.backoff import RandomizedBackoff
+    from fishnet_tpu.client.logger import Logger
+    from fishnet_tpu.client.wire import EngineFlavor
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.autoscaler import AutoscaleConfig, Autoscaler
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+    from fishnet_tpu.serve.server import ServeApp
+    from tools.loadgen import LoadProfile, generate_schedule, run_load
+
+    base_rps = float(os.environ.get("BENCH_AUTOSCALE_RPS", "2"))
+    latency_ms = float(os.environ.get("BENCH_AUTOSCALE_LATENCY_MS", "80"))
+    profile = LoadProfile(
+        pattern="flash", duration_s=8.0, base_rps=base_rps,
+        flash_factor=10.0, flash_start=0.125, flash_len=0.375,
+        tenants=3, bestmove_ratio=0.0, positions=2, depth=1,
+        timeout_ms=1500,
+    )
+    # one schedule, one seed: both modes replay the same arrivals
+    schedule = generate_schedule(profile, seed=42)
+    as_cfg = AutoscaleConfig(
+        min_members=1, max_members=3, interval_s=0.15,
+        up_queue=1, up_ticks=2, down_ticks=5,
+        loss_cooldown_s=1.0, drain_timeout_s=20.0,
+    )
+
+    def member(name: str):
+        return make_local_member(
+            name,
+            host_cmd=[
+                sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                "--script", '{"chunks": ["ok"]}',
+                "--hb-interval", "0.05",
+                "--latency-ms", str(latency_ms),
+            ],
+            logger=Logger(verbose=0),
+            hb_interval=0.05, hb_timeout=2.0,
+            backoff=RandomizedBackoff(max_s=0.1),
+        )
+
+    async def drive(autoscale_on: bool) -> dict:
+        coord = FleetCoordinator(
+            [member("as0")], logger=Logger(verbose=0),
+            registry=MetricsRegistry(), loss_window=1.0,
+            local_factory=member,
+        )
+        app = ServeApp(
+            EngineSession(coord, flavor=EngineFlavor.TPU),
+            # positions-denominated admission: 4 concurrent 2-position
+            # requests; the member's serial chunk service is the real
+            # bottleneck the autoscaler relieves
+            max_inflight=8, max_queue=96,
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+        )
+        autoscaler = (
+            Autoscaler(coord, app.admission, config=as_cfg,
+                       registry=app.registry, logger=Logger(verbose=0))
+            if autoscale_on else None
+        )
+        members_trace = []
+
+        def on_tick(t):
+            n = len(coord.members)
+            if not members_trace or members_trace[-1][1] != n:
+                members_trace.append([round(t, 2), n])
+
+        try:
+            await coord.start()
+            host, port = await app.start("127.0.0.1", 0)
+            if autoscaler is not None:
+                autoscaler.start()
+            report = await run_load(
+                host, port, schedule, logger=Logger(verbose=0),
+                drain_timeout_s=60.0, on_tick=on_tick,
+            )
+            if autoscaler is not None:
+                # post-burst: the loop must drain back to the floor
+                floor_deadline = time.monotonic() + 25.0
+                while time.monotonic() < floor_deadline:
+                    snap = autoscaler.snapshot()
+                    if (snap["members"] == as_cfg.min_members
+                            and snap["draining"] is None):
+                        break
+                    await asyncio.sleep(0.1)
+        finally:
+            if autoscaler is not None:
+                await autoscaler.stop()
+            await app.drain_and_stop()
+            await coord.close()
+
+        snap = app.registry.snapshot()
+        late = sum(v for k, v in snap.items()
+                   if k.startswith("fishnet_slo_deadline_miss_total_"))
+        d = report.as_dict()
+        # deadline-miss rate over the whole schedule: answered-late
+        # (SloRecorder deadline_miss), failed (the engine refuses to
+        # search past an expired deadline — a 500 here IS a missed
+        # deadline), and shed all violated the request's SLO
+        violations = late + d["errors"] + d["shed"]
+        row = {
+            "ok": d["ok"],
+            "shed": d["shed"],
+            "errors": d["errors"],
+            "answered_late": late,
+            "p99_ms": d["per_kind"].get("analysis", {}).get("p99_ms", 0.0),
+            "miss_rate": round(violations / max(len(schedule), 1), 4),
+            "members_trace": members_trace,
+            "members_final": len(coord.members),
+        }
+        if autoscaler is not None:
+            seq = [dec.action for dec in autoscaler.decisions
+                   if dec.action in ("up", "down")]
+            row.update({
+                "ups": autoscaler.stats.ups,
+                "downs": autoscaler.stats.downs,
+                # a second up-burst after a down is a flap: hysteresis
+                # promises at most one reversal per burst
+                "reversals": sum(
+                    1 for a, b in zip(seq, seq[1:])
+                    if a == "down" and b == "up"
+                ),
+                "member_seconds": round(autoscaler.stats.member_seconds, 1),
+            })
+        return row
+
+    rows = {}
+    for mode, flag in (("autoscale_off", False), ("autoscale_on", True)):
+        try:
+            rows[mode] = asyncio.run(
+                asyncio.wait_for(drive(flag), timeout=min(timeout, 120.0)))
+        except (Exception, asyncio.TimeoutError) as e:
+            print(f"bench autoscale_flash: {mode} run failed: {e}",
+                  file=sys.stderr, flush=True)
+            return None
+    return {
+        "requests": len(schedule),
+        "latency_ms": latency_ms,
+        "floor": as_cfg.min_members,
+        "ceiling": as_cfg.max_members,
+        **rows,
+    }
+
+
 def run_coldstart_stage(timeout: float) -> dict | None:
     """Cold-start A/B row (AOT program assets, fishnet_tpu/aot/):
     time-to-first-result of a FRESH engine process, plain JIT vs booted
@@ -1477,6 +1638,24 @@ def main() -> None:
             res = run_fleet_tail_stage(min(stage_timeout, remaining))
             matrix["fleet_tail"] = res
             print("bench config fleet_tail: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # autoscale flash row (ISSUE 16): the same open-loop flash crowd,
+    # autoscaler off vs on — the miss-rate delta and the member-count
+    # trace are the elastic-capacity feature next to fleet_scaling's
+    # static-membership story
+    if os.environ.get("BENCH_AUTOSCALE",
+                      os.environ.get("BENCH_FLEET", "1")) != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 60.0:
+            print("bench: skipping autoscale_flash (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["autoscale_flash"] = None
+        else:
+            res = run_autoscale_flash_stage(min(stage_timeout, remaining))
+            matrix["autoscale_flash"] = res
+            print("bench config autoscale_flash: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
 

@@ -70,8 +70,10 @@ def make_engine_factory(cfg: Config, logger: Logger, stats=None):
                     members_from_specs,
                 )
 
+                # the engine host child speaks --backend tpu|py; the
+                # CLI's "python" backend maps to its "py"
                 backend = (
-                    cfg.backend if cfg.backend in ("tpu", "python")
+                    "py" if cfg.backend == "python"
                     else "tpu"
                 )
 
@@ -431,7 +433,8 @@ def run_fleet_ctl(cfg: Config) -> int:
     NAME]`: runtime membership against a running fleet front-end's
     /fleet/members admin surface (--serve-host/--serve-port pick the
     target). `drain` + `remove` + `add` is a zero-loss rolling restart
-    (docs/fleet.md)."""
+    (docs/fleet.md). `--json` makes `list` print the raw health payload
+    (machine-readable; scripts and the autoscaling runbook use it)."""
     import json
     import urllib.error
     import urllib.request
@@ -476,6 +479,9 @@ def run_fleet_ctl(cfg: Config) -> int:
         return 1
     if action != "list":
         print(json.dumps(payload, indent=2))
+        return 0
+    if cfg.json_output:
+        print(json.dumps(payload, indent=2, sort_keys=True))
         return 0
     members = payload.get("members") or []
     print(

@@ -133,6 +133,14 @@ class Config:
     # is `serve` with the coordinator forced on
     fleet: bool = False
     fleet_members: Optional[str] = None
+    # elastic capacity (fishnet_tpu/fleet/autoscaler.py): tri-state —
+    # unset (None) defers to the FISHNET_TPU_AUTOSCALE registry setting;
+    # min/max override the FISHNET_TPU_AUTOSCALE_MIN/_MAX clamp
+    autoscale: Optional[bool] = None
+    autoscale_min: Optional[int] = None
+    autoscale_max: Optional[int] = None
+    # fleet-ctl: machine-readable output (`fleet-ctl --json list`)
+    json_output: bool = False
     # AOT program assets (fishnet_tpu/aot/): `pack` builds a bundle,
     # `warm` installs one. aot_bundle = pack output / warm source;
     # aot_dir = warm's install target. Engines read the store root from
@@ -215,6 +223,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "(supervised host children here) or "
                         "'http://HOST:PORT' (remote serve endpoints); "
                         "default FISHNET_TPU_FLEET_MEMBERS")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the elastic-capacity control loop on the "
+                        "fleet coordinator (fishnet_tpu/fleet/"
+                        "autoscaler.py); requires --fleet")
+    p.add_argument("--no-autoscale", action="store_true",
+                   help="force the autoscaler off even when "
+                        "FISHNET_TPU_AUTOSCALE is set")
+    p.add_argument("--autoscale-min", type=int,
+                   help="autoscaler member floor (default "
+                        "FISHNET_TPU_AUTOSCALE_MIN)")
+    p.add_argument("--autoscale-max", type=int,
+                   help="autoscaler member ceiling (default "
+                        "FISHNET_TPU_AUTOSCALE_MAX)")
+    p.add_argument("--json", action="store_true", dest="json_output",
+                   help="fleet-ctl list: print the raw health payload as "
+                        "JSON instead of the human table")
     p.add_argument("--aot-bundle",
                    help="pack subcommand: output directory for the AOT "
                         "program bundle (default: the live store); warm "
@@ -320,6 +344,17 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.fleet = bool(args.fleet) or args.command == "fleet" or \
         str(ini.get("fleet", "")).strip().lower() in ("1", "true", "yes", "on")
     cfg.fleet_members = pick(args.fleet_members, "fleet_members")
+    # tri-state autoscale: unset (None) defers to FISHNET_TPU_AUTOSCALE
+    autoscale_ini = str(ini.get("autoscale", "")).strip().lower()
+    if args.no_autoscale or autoscale_ini in ("0", "false", "no", "off"):
+        cfg.autoscale = False
+    elif args.autoscale or autoscale_ini:
+        cfg.autoscale = True
+    autoscale_min = pick(args.autoscale_min, "autoscale_min")
+    cfg.autoscale_min = int(autoscale_min) if autoscale_min is not None else None
+    autoscale_max = pick(args.autoscale_max, "autoscale_max")
+    cfg.autoscale_max = int(autoscale_max) if autoscale_max is not None else None
+    cfg.json_output = bool(args.json_output)
     cfg.aot_bundle = pick(args.aot_bundle, "aot_bundle")
     cfg.aot_dir = pick(args.aot_dir, "aot_dir")
     cfg.user_backlog = parse_backlog(pick(args.user_backlog, "user_backlog"))

@@ -69,6 +69,11 @@ chunk before its scripted action runs (heartbeats continue). Unlike the
 one-shot `slow:S` action this models a member's steady-state speed, so
 fleet load-balancing and scaling tests (tests/test_fleet.py, bench.py
 fleet_scaling) can build deterministically asymmetric members.
+`--jitter-ms N` layers uniform [0, N] ms of per-chunk jitter on top —
+service-time VARIANCE rather than speed — drawn from a RNG seeded by
+(--jitter-seed, chunk index), so the delay sequence is reproducible
+across runs and across respawns of the same member (an incarnation
+resuming at chunk k sleeps exactly what the dead one would have).
 
 `FlakyProxy` (in-process, asyncio) is the NETWORK counterpart of the
 fault scripts: a TCP shim between a remote fleet member (HttpEngine)
@@ -85,6 +90,7 @@ import argparse
 import asyncio
 import json
 import os
+import random
 import socket as _socket
 import struct
 import sys
@@ -345,6 +351,11 @@ def main(argv=None) -> int:
     # fixed per-chunk service delay (fleet asymmetric-member tests);
     # applied before every chunk's scripted action, heartbeats continue
     p.add_argument("--latency-ms", type=float, default=0.0)
+    # uniform per-chunk latency jitter in [0, N] ms on top of
+    # --latency-ms, drawn from a --jitter-seed'd RNG so a given member
+    # incarnation replays the identical delay sequence
+    p.add_argument("--jitter-ms", type=float, default=0.0)
+    p.add_argument("--jitter-seed", type=int, default=0)
     # clock-sync fault injection (obs/trace.py ClockSync): report a
     # monotonic clock running S seconds BEHIND the real one in hb/ready
     # `mono` fields, and stream a synthetic child trace ring stamped on
@@ -427,6 +438,12 @@ def main(argv=None) -> int:
         action = _action(script.get("chunks"), chunk_idx, "ok")
         if args.latency_ms > 0:
             time.sleep(args.latency_ms / 1000.0)
+        if args.jitter_ms > 0:
+            # seeded per chunk INDEX (not per boot) so a respawned
+            # incarnation resuming at chunk k sleeps the same jitter
+            # the dead one would have
+            jrng = random.Random(f"{args.jitter_seed}:{chunk_idx}")
+            time.sleep(jrng.uniform(0.0, args.jitter_ms) / 1000.0)
 
         if args.trace_skew is not None:
             # one synthetic span per chunk, stamped on the SKEWED clock

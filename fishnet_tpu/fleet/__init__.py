@@ -28,18 +28,32 @@ one metrics registry (obs/metrics.py), so the whole fleet is one
 Perfetto timeline and one Prometheus endpoint.
 
 `python -m fishnet_tpu fleet` serves the coordinator over HTTP
-standalone; `serve`/`run` grow a `--fleet` engine factory. docs/fleet.md
-has the topology, the member-spec grammar and the failure ladder.
+standalone; `serve`/`run` grow a `--fleet` engine factory. The
+`Autoscaler` (autoscaler.py) closes the capacity loop on top: it reads
+the admission/SLO/fleet congestion signals and adds or drains members
+through a pluggable `CapacityProvider` — docs/autoscaling.md has the
+control-loop semantics. docs/fleet.md has the topology, the member-spec
+grammar and the failure ladder.
 """
+from .autoscaler import (
+    AutoscaleConfig,
+    Autoscaler,
+    CapacityProvider,
+    LocalProcessProvider,
+)
 from .coordinator import FleetCoordinator, FleetStats, LossEvent
 from .member import FleetMember, make_local_member, members_from_specs
 from .remote import HttpEngine
 
 __all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
+    "CapacityProvider",
     "FleetCoordinator",
     "FleetMember",
     "FleetStats",
     "HttpEngine",
+    "LocalProcessProvider",
     "LossEvent",
     "make_local_member",
     "members_from_specs",

@@ -1,0 +1,413 @@
+"""Elastic capacity: the autoscaling control loop over the fleet.
+
+The serve/fleet stack exports every congestion signal — admission queue
+depth and EWMA drain rate (serve/admission.py), per-tenant deadline-miss
+counters (obs/metrics.py SloRecorder), per-member occupancy/backlog and
+lifecycle state (fleet/coordinator.py health tables) — but until this
+module nothing *decided*: a 10x flash crowd just shed 429s until a human
+ran `fleet-ctl add`. The Autoscaler closes ROADMAP item 4's loop:
+
+- **Signals** are read straight off the live objects each tick (the
+  same numbers the registry gauges export — docs/serving.md "Capacity
+  signals", docs/fleet.md "Autoscaling signals"): admission occupancy
+  `(inflight, queued)`, the measured drain rate, the fleet-wide member
+  backlog, each member's one-word lifecycle state, and the SloRecorder
+  deadline-miss counters out of the registry snapshot.
+- **Decisions** are add/drain against a pluggable CapacityProvider.
+  LocalProcessProvider spawns local members through the coordinator's
+  `add_member("local")` (the same `make_local_member` factory fleet-ctl
+  uses); a real TPU-provisioning provider plugs in later behind the
+  same four methods.
+- **Hysteresis**: scale UP only after `up_ticks` consecutive pressure
+  ticks (queued >= up_queue, or a deadline miss recorded this tick);
+  scale DOWN only after `down_ticks` consecutive fully-idle ticks.
+  The asymmetry (down_ticks >> up_ticks) is the anti-flap guarantee:
+  one burst costs at most one up/down reversal.
+- **Loss cooldown**: never scale down while the coordinator is
+  mid-recovery-ladder — any member in cooldown/probing/probation, or
+  within `loss_cooldown_s` of the last loss event. Removing capacity
+  while redispatch/probation is running would turn a transient fault
+  into a real brown-out; blocked decisions are counted
+  (`fishnet_autoscale_down_blocked_total`) and logged.
+- **Clamp**: member count stays inside [min_members, max_members].
+  Only members the autoscaler itself added are ever drained — the
+  configured floor fleet is never touched, so "return to floor" is
+  structural, not emergent.
+- **Cost accounting**: `fishnet_autoscale_member_seconds_total`
+  accumulates members x wall-clock each tick — the number a capacity
+  bill is proportional to — next to `fishnet_autoscale_members` /
+  `_up_total` / `_down_total` / `_down_blocked_total` in the one
+  metrics registry. Every decision also lands as an
+  `autoscale.decision` trace instant on the shared timeline.
+
+Capacity changes never alter answers: the autoscaler only calls
+add_member/begin_drain/remove_member, and the coordinator's dispatch
+planning plus the exactly-once fingerprint ledger keep search results
+bit-identical with the loop on or off (tests/test_autoscaler.py).
+
+Pure stdlib, no JAX at module scope (the fleet/serve constraint).
+Single-writer: the loop runs as one asyncio task on the serve loop;
+nothing else mutates its streak counters.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..client.logger import Logger
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
+
+__all__ = [
+    "AutoscaleConfig",
+    "Autoscaler",
+    "CapacityProvider",
+    "Decision",
+    "LocalProcessProvider",
+]
+
+
+class CapacityProvider:
+    """How the autoscaler acquires and releases capacity. Four methods,
+    deliberately tiny so a cloud TPU provisioner can implement them:
+    `add` returns the new member's name once it is serving; drain is
+    split into begin/poll/remove so in-flight work always finishes
+    before capacity disappears (zero lost positions by construction)."""
+
+    async def add(self) -> str:
+        raise NotImplementedError
+
+    def begin_drain(self, name: str) -> None:
+        raise NotImplementedError
+
+    def drained(self, name: str) -> bool:
+        raise NotImplementedError
+
+    async def remove(self, name: str) -> None:
+        raise NotImplementedError
+
+
+class LocalProcessProvider(CapacityProvider):
+    """Local process spawn via the coordinator's runtime-membership
+    path: `add_member("local")` builds the member through the same
+    `local_factory` / `make_local_member` closure fleet-ctl and the
+    POST /fleet/members endpoint use, so an autoscaled member is
+    indistinguishable from a hand-added one."""
+
+    def __init__(self, coordinator, spec: str = "local") -> None:
+        self.coordinator = coordinator
+        self.spec = spec
+
+    async def add(self) -> str:
+        row = await self.coordinator.add_member(self.spec)
+        return row["name"]
+
+    def begin_drain(self, name: str) -> None:
+        self.coordinator.begin_drain(name)
+
+    def drained(self, name: str) -> bool:
+        return self.coordinator.drained(name)
+
+    async def remove(self, name: str) -> None:
+        await self.coordinator.remove_member(name)
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    """Control-loop knobs (registry: FISHNET_TPU_AUTOSCALE*)."""
+
+    min_members: int = 1
+    max_members: int = 4
+    interval_s: float = 1.0
+    # pressure: queued positions at admission that count as undersized
+    up_queue: int = 1
+    # hysteresis: consecutive pressure/idle ticks before acting
+    up_ticks: int = 2
+    down_ticks: int = 5
+    # never scale down within this many seconds of a member-loss event
+    loss_cooldown_s: float = 30.0
+    # a draining member that still holds work after this long gets a
+    # drain-stalled decision logged (and keeps draining — work is never
+    # abandoned to meet a schedule)
+    drain_timeout_s: float = 30.0
+
+    @classmethod
+    def from_settings(cls) -> "AutoscaleConfig":
+        from ..utils import settings
+
+        return cls(
+            min_members=settings.get_int("FISHNET_TPU_AUTOSCALE_MIN"),
+            max_members=settings.get_int("FISHNET_TPU_AUTOSCALE_MAX"),
+            interval_s=settings.get_int(
+                "FISHNET_TPU_AUTOSCALE_INTERVAL_MS") / 1000.0,
+            up_queue=settings.get_int("FISHNET_TPU_AUTOSCALE_UP_QUEUE"),
+            up_ticks=settings.get_int("FISHNET_TPU_AUTOSCALE_UP_TICKS"),
+            down_ticks=settings.get_int("FISHNET_TPU_AUTOSCALE_DOWN_TICKS"),
+            loss_cooldown_s=float(settings.get_int(
+                "FISHNET_TPU_AUTOSCALE_LOSS_COOLDOWN_S")),
+        )
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One control-loop action, kept for runbooks and the chaos gate."""
+
+    at: float  # time.monotonic()
+    action: str  # up | down | down-blocked | removed | drain-stalled
+    reason: str
+    members: int
+
+
+@dataclass
+class AutoscalerStats:
+    ticks: int = 0
+    ups: int = 0
+    downs: int = 0
+    downs_blocked: int = 0
+    member_seconds: float = 0.0
+
+
+class Autoscaler:
+    """The control loop. Reads signals, decides, actuates, accounts.
+
+    One structural change is in flight at a time: a scale-down is a
+    begin_drain now and a remove on the later tick that observes the
+    drain complete, and no new decision is taken while that drain is
+    pending — capacity changes stay serialized and observable.
+    """
+
+    def __init__(
+        self,
+        coordinator,
+        admission,
+        *,
+        provider: Optional[CapacityProvider] = None,
+        config: Optional[AutoscaleConfig] = None,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+        logger: Optional[Logger] = None,
+    ) -> None:
+        self.coordinator = coordinator
+        self.admission = admission
+        self.provider = provider or LocalProcessProvider(coordinator)
+        self.config = config or AutoscaleConfig()
+        if self.config.min_members < 1:
+            raise ValueError("autoscale: min_members must be >= 1")
+        if self.config.max_members < self.config.min_members:
+            raise ValueError("autoscale: max_members < min_members")
+        self.registry = (registry if registry is not None
+                         else getattr(coordinator, "registry", None)
+                         or obs_metrics.REGISTRY)
+        self.logger = logger or Logger()
+        self.stats = AutoscalerStats()
+        self.decisions: List[Decision] = []
+        self._owned: List[str] = []  # members this loop added (LIFO)
+        self._draining: Optional[str] = None
+        self._drain_deadline = 0.0
+        self._drain_stalled = False
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_losses: Optional[int] = None
+        self._loss_cooldown_until = 0.0
+        self._last_miss_total: Optional[float] = None
+        self._last_tick: Optional[float] = None
+        self._stop = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._g_members = self.registry.gauge(
+            "fishnet_autoscale_members",
+            "fleet member count as seen by the autoscaler",
+        )
+        self._g_floor = self.registry.gauge(
+            "fishnet_autoscale_floor", "autoscaler min-member clamp")
+        self._g_ceiling = self.registry.gauge(
+            "fishnet_autoscale_ceiling", "autoscaler max-member clamp")
+        self._c_member_seconds = self.registry.counter(
+            "fishnet_autoscale_member_seconds_total",
+            "accumulated member-count x wall-clock seconds (cost gauge)",
+        )
+        self._c_up = self.registry.counter(
+            "fishnet_autoscale_up_total", "scale-up decisions")
+        self._c_down = self.registry.counter(
+            "fishnet_autoscale_down_total", "scale-down decisions")
+        self._c_down_blocked = self.registry.counter(
+            "fishnet_autoscale_down_blocked_total",
+            "scale-downs refused mid-recovery-ladder",
+        )
+        self._g_floor.set(self.config.min_members)
+        self._g_ceiling.set(self.config.max_members)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Spawn the loop task on the running event loop."""
+        if self._task is not None:
+            raise RuntimeError("autoscaler already started")
+        self._stop.clear()
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        """Stop the loop; a pending drain is left to the coordinator
+        (close() tears members down anyway)."""
+        self._stop.set()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=10.0)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+            self._task = None
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.tick()
+            except Exception as e:  # one bad tick must not kill the loop
+                self.logger.error(f"autoscale: tick failed: {e}")
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.config.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------- signals
+
+    def _miss_delta(self) -> float:
+        """Deadline misses recorded since the previous tick, summed over
+        every (kind, tenant) SloRecorder counter in the registry."""
+        total = sum(
+            v for k, v in self.registry.snapshot().items()
+            if k.startswith("fishnet_slo_deadline_miss_total_")
+        )
+        prev = self._last_miss_total
+        self._last_miss_total = total
+        if prev is None:
+            return 0.0
+        return max(0.0, total - prev)
+
+    def recovery_ladder_active(self, now: Optional[float] = None) -> bool:
+        """True while any member sits on the loss ladder (cooldown /
+        probing / probation) or the last loss event is closer than
+        loss_cooldown_s — the scale-down veto window."""
+        if now is None:
+            now = time.monotonic()
+        if now < self._loss_cooldown_until:
+            return True
+        return any(
+            m.state(now) in ("cooldown", "probing", "probation")
+            for m in self.coordinator.members
+        )
+
+    # -------------------------------------------------------------- loop
+
+    def _record(self, action: str, reason: str, members: int) -> None:
+        self.decisions.append(
+            Decision(at=time.monotonic(), action=action, reason=reason,
+                     members=members))
+        del self.decisions[:-1000]  # bound the log
+        obs_trace.instant("autoscale.decision", "fleet", action=action,
+                          reason=reason, members=members)
+        self.logger.info(f"autoscale: {action} ({reason}); "
+                         f"members={members}")
+
+    async def tick(self) -> None:
+        """One control-loop pass. Public so tests and the chaos harness
+        can drive the loop deterministically without the timer."""
+        cfg = self.config
+        now = time.monotonic()
+        members = len(self.coordinator.members)
+        if self._last_tick is not None:
+            dt = now - self._last_tick
+            self.stats.member_seconds += members * dt
+            self._c_member_seconds.inc(members * dt)
+        self._last_tick = now
+        self.stats.ticks += 1
+        self._g_members.set(members)
+
+        # loss accounting first: a loss this tick opens the veto window
+        losses = self.coordinator.stats.losses
+        if self._last_losses is None:
+            self._last_losses = losses
+        elif losses > self._last_losses:
+            self._last_losses = losses
+            self._loss_cooldown_until = now + cfg.loss_cooldown_s
+
+        inflight, queued = self.admission.occupancy()
+        backlog = sum(m.backlog for m in self.coordinator.members)
+        misses = self._miss_delta()
+        pressure = queued >= cfg.up_queue or misses > 0
+        idle = queued == 0 and inflight == 0 and backlog == 0
+        self._up_streak = self._up_streak + 1 if pressure else 0
+        self._down_streak = self._down_streak + 1 if idle else 0
+
+        # a pending drain serializes all structural change: finish it
+        # (or report it stalled) before considering anything else
+        if self._draining is not None:
+            name = self._draining
+            if self.provider.drained(name):
+                await self.provider.remove(name)
+                self._draining = None
+                self._drain_stalled = False
+                self._record("removed", f"{name} drained",
+                             len(self.coordinator.members))
+            elif now > self._drain_deadline and not self._drain_stalled:
+                self._drain_stalled = True  # report once, keep draining
+                self._record("drain-stalled",
+                             f"{name} still busy after "
+                             f"{cfg.drain_timeout_s:.0f}s", members)
+            return
+
+        if (pressure and self._up_streak >= cfg.up_ticks
+                and members < cfg.max_members):
+            name = await self.provider.add()
+            self._owned.append(name)
+            self.stats.ups += 1
+            self._c_up.inc()
+            self._up_streak = 0
+            self._down_streak = 0
+            self._record(
+                "up",
+                f"queued={queued} misses={misses:.0f} -> +{name}",
+                len(self.coordinator.members))
+            self._g_members.set(len(self.coordinator.members))
+            return
+
+        if (self._down_streak >= cfg.down_ticks
+                and members > cfg.min_members and self._owned):
+            if self.recovery_ladder_active(now):
+                self.stats.downs_blocked += 1
+                self._c_down_blocked.inc()
+                self._down_streak = 0  # re-earn idleness after the ladder
+                self._record("down-blocked",
+                             "recovery ladder active", members)
+                return
+            name = self._owned.pop()
+            self.provider.begin_drain(name)
+            self._draining = name
+            self._drain_deadline = now + cfg.drain_timeout_s
+            self._drain_stalled = False
+            self.stats.downs += 1
+            self._c_down.inc()
+            self._down_streak = 0
+            self._record("down", f"idle -> draining {name}", members)
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """Machine-readable loop state for /healthz, bench and chaos."""
+        return {
+            "members": len(self.coordinator.members),
+            "floor": self.config.min_members,
+            "ceiling": self.config.max_members,
+            "owned": list(self._owned),
+            "draining": self._draining,
+            "ticks": self.stats.ticks,
+            "ups": self.stats.ups,
+            "downs": self.stats.downs,
+            "downs_blocked": self.stats.downs_blocked,
+            "member_seconds": round(self.stats.member_seconds, 3),
+            "decisions": [
+                {"action": d.action, "reason": d.reason,
+                 "members": d.members}
+                for d in self.decisions[-20:]
+            ],
+        }

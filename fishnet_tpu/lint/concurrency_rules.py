@@ -80,7 +80,10 @@ from .core import (
 
 # modules where an unbounded block is a liveness bug. fishnet_tpu/aot
 # is in scope: the registry's export threads and flush() joins sit on
-# the engine boot path, and an unbounded wait there wedges warmup
+# the engine boot path, and an unbounded wait there wedges warmup.
+# fishnet_tpu/fleet covers the autoscaler (fleet/autoscaler.py) by
+# prefix; tools/loadgen.py is named explicitly — its open-loop firing
+# task shares the client event loop, so the same liveness rules apply
 BLOCK_SCOPE = (
     "fishnet_tpu/engine/supervisor.py",
     "fishnet_tpu/engine/host.py",
@@ -90,17 +93,20 @@ BLOCK_SCOPE = (
     "fishnet_tpu/serve",
     "fishnet_tpu/fleet",
     "fishnet_tpu/aot",
+    "tools/loadgen.py",
 )
 
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine",
                 "fishnet_tpu/serve", "fishnet_tpu/fleet",
-                "fishnet_tpu/aot")
+                "fishnet_tpu/aot", "tools/loadgen.py")
 
 # these packages run inside ONE shared event loop: a blocking socket
-# call in an async def stalls every tenant (serve) or every member
-# dispatch (fleet) at once
-SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve", "fishnet_tpu/fleet")
+# call in an async def stalls every tenant (serve), every member
+# dispatch (fleet — the autoscaler control loop rides the same loop),
+# or every open-loop arrival (tools/loadgen.py) at once
+SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve", "fishnet_tpu/fleet",
+                     "tools/loadgen.py")
 
 # call targets that block the thread: raw socket ops, sync HTTP
 # clients, and the sleep that should have been asyncio.sleep. Matched
@@ -115,9 +121,11 @@ _BLOCKING_IN_LOOP_TAILS = ("accept", "connect", "recv", "recv_into",
                            "HTTPConnection", "HTTPSConnection")
 
 # modules that talk to peers over the wire: an unbounded retry loop
-# here turns one dead peer into a coroutine that spins forever
+# here turns one dead peer into a coroutine that spins forever.
+# tools/loadgen.py is open-loop BY CONTRACT — a retry loop there would
+# silently convert it to closed-loop — so the same rule polices it
 RETRY_SCOPE = ("fishnet_tpu/fleet", "fishnet_tpu/serve",
-               "fishnet_tpu/client")
+               "fishnet_tpu/client", "tools/loadgen.py")
 
 # awaited call tails that reach the network. Deliberately narrow:
 # `acquire`/`go_multiple` are absent so the work queue's long-poll

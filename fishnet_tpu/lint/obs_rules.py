@@ -7,7 +7,9 @@ step mid-run folds the timeline over itself, and the 290 us/step-class
 measurements the ROADMAP's perf items depend on become unreproducible.
 
 Rules:
-  obs-wall-clock   any `time.time()` call in a file under fishnet_tpu/.
+  obs-wall-clock   any `time.time()` call in a file under fishnet_tpu/
+                   or in tools/loadgen.py (whose latency percentiles and
+                   arrival offsets feed the same reports).
                    Durations and intervals must use time.monotonic() (or
                    the trace clock, obs/trace.py now_us). The sanctioned
                    exception — REPORT timestamps that must correlate
@@ -126,7 +128,7 @@ def check_obs_orphan_span(project: Project) -> List[Finding]:
     """Context propagation: no hop across a process boundary may drop
     the request context."""
     findings: List[Finding] = []
-    for src in project.in_dirs("fishnet_tpu"):
+    for src in project.in_dirs("fishnet_tpu", "tools/loadgen.py"):
         for kind, node, fn in _dispatch_sites(src):
             if kind == "partial":
                 if _mentions_ctx(fn):
@@ -170,7 +172,7 @@ def check_obs_orphan_span(project: Project) -> List[Finding]:
 def check_obs_clock(project: Project) -> List[Finding]:
     """Clock discipline: wall clock never measures durations."""
     findings: List[Finding] = []
-    for src in project.in_dirs("fishnet_tpu"):
+    for src in project.in_dirs("fishnet_tpu", "tools/loadgen.py"):
         for node in _time_call_sites(src):
             findings.append(src.finding(
                 "obs-wall-clock", node,

@@ -502,6 +502,41 @@ async def run_serve(cfg) -> int:
             f"http://127.0.0.1:{metrics_server.server_address[1]}/metrics"
         )
 
+    # elastic capacity (fleet/autoscaler.py): only meaningful with a
+    # fleet engine — the control loop drives the coordinator's runtime
+    # membership off this app's admission/SLO signals. Starts after the
+    # listener opens (the floor fleet is already warm) and stops before
+    # drain so no membership change races the shutdown.
+    autoscaler = None
+    autoscale_on = (
+        cfg.autoscale if getattr(cfg, "autoscale", None) is not None
+        else settings.get_bool("FISHNET_TPU_AUTOSCALE")
+    )
+    if autoscale_on and getattr(cfg, "fleet", False):
+        from ..fleet.autoscaler import AutoscaleConfig, Autoscaler
+
+        as_cfg = AutoscaleConfig.from_settings()
+        if getattr(cfg, "autoscale_min", None) is not None or \
+                getattr(cfg, "autoscale_max", None) is not None:
+            from dataclasses import replace as _dc_replace
+
+            kw = {}
+            if getattr(cfg, "autoscale_min", None) is not None:
+                kw["min_members"] = cfg.autoscale_min
+            if getattr(cfg, "autoscale_max", None) is not None:
+                kw["max_members"] = cfg.autoscale_max
+            as_cfg = _dc_replace(as_cfg, **kw)
+        autoscaler = Autoscaler(
+            engine, app.admission, config=as_cfg, logger=logger,
+        )
+        autoscaler.start()
+        logger.info(
+            f"serve: autoscaler on (members {as_cfg.min_members}.."
+            f"{as_cfg.max_members}, tick {as_cfg.interval_s:g}s)"
+        )
+    elif autoscale_on:
+        logger.info("serve: autoscale requested without --fleet; off.")
+
     loop = asyncio.get_running_loop()
     stop = asyncio.Event()
     try:
@@ -510,6 +545,8 @@ async def run_serve(cfg) -> int:
     except NotImplementedError:
         pass  # non-unix
     await stop.wait()  # fishnet-lint: disable=conc-no-timeout
+    if autoscaler is not None:
+        await autoscaler.stop()
     await app.drain_and_stop()
     await session.close()
     await engine.close()

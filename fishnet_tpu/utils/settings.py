@@ -409,6 +409,72 @@ SETTINGS: Tuple[Setting, ...] = (
             "with FISHNET_TPU_FLEET_HEDGE=1).",
     ),
     Setting(
+        name="FISHNET_TPU_AUTOSCALE",
+        kind="bool",
+        default="0",
+        doc="Elastic capacity (fleet/autoscaler.py): run the autoscaling "
+            "control loop next to `serve --fleet`, adding local members "
+            "under admission-queue pressure or deadline misses and "
+            "draining them back to the floor when idle. Capacity changes "
+            "never alter search results.",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_MIN",
+        kind="int",
+        default="1",
+        doc="Autoscaler member-count floor: the loop never drains below "
+            "this many members, and only ever drains members it added "
+            "itself (the configured fleet is the floor).",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_MAX",
+        kind="int",
+        default="4",
+        doc="Autoscaler member-count ceiling: scale-up stops here no "
+            "matter the backlog (the cost clamp).",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_INTERVAL_MS",
+        kind="int",
+        default="1000",
+        doc="Autoscaler control-loop tick interval in milliseconds; "
+            "hysteresis counts ticks, so the up/down reaction times are "
+            "UP_TICKS x this and DOWN_TICKS x this.",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_UP_QUEUE",
+        kind="int",
+        default="1",
+        doc="Admission-queue depth (queued positions) that counts as "
+            "scale-up pressure for a tick; a deadline miss recorded "
+            "during the tick counts as pressure regardless.",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_UP_TICKS",
+        kind="int",
+        default="2",
+        doc="Consecutive pressure ticks before the autoscaler adds a "
+            "member (scale-up hysteresis).",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_DOWN_TICKS",
+        kind="int",
+        default="5",
+        doc="Consecutive fully-idle ticks (no queue, no in-flight, no "
+            "member backlog) before the autoscaler drains a member "
+            "(scale-down hysteresis; deliberately slower than scale-up "
+            "so one burst costs at most one up/down reversal).",
+    ),
+    Setting(
+        name="FISHNET_TPU_AUTOSCALE_LOSS_COOLDOWN_S",
+        kind="int",
+        default="30",
+        doc="Scale-down veto window after a member-loss event: the loop "
+            "never drains while any member is in cooldown/probing/"
+            "probation or within this many seconds of the last loss "
+            "(never shrink mid-recovery-ladder).",
+    ),
+    Setting(
         name="FISHNET_TPU_AOT",
         kind="bool",
         default="1",

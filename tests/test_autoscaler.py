@@ -1,0 +1,456 @@
+"""Autoscaler control-loop tests: hysteresis, loss-cooldown veto,
+clamps, drain serialization, cost accounting — and the contract that
+capacity changes never alter answers (bit-identity through a real
+FleetCoordinator while the loop scales it up and back down).
+
+The loop is driven deterministically through the public `tick()`
+against stub signals — no timers, no sleeps on the decision paths.
+"""
+import asyncio
+import io
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.engine.pyengine import PyEngine
+from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+from fishnet_tpu.fleet.autoscaler import (
+    AutoscaleConfig,
+    Autoscaler,
+    CapacityProvider,
+)
+from fishnet_tpu.obs.metrics import MetricsRegistry
+
+# ------------------------------------------------------------------ stubs
+
+
+class StubMember:
+    """Just the three member attributes the autoscaler reads."""
+
+    def __init__(self, name, backlog=0, lifecycle="serving"):
+        self.name = name
+        self.backlog = backlog
+        self.lifecycle = lifecycle
+
+    def state(self, now):
+        return self.lifecycle
+
+
+class StubCoordinator:
+    def __init__(self, names=("m0",)):
+        self.members = [StubMember(n) for n in names]
+        self.stats = SimpleNamespace(losses=0)
+
+
+class StubProvider(CapacityProvider):
+    """In-memory capacity: add appends a member, drain completes only
+    when the test says so."""
+
+    def __init__(self, coord):
+        self.coord = coord
+        self.added = 0
+        self.drain_ready = {}
+
+    async def add(self):
+        name = f"auto{self.added}"
+        self.added += 1
+        self.coord.members.append(StubMember(name))
+        return name
+
+    def begin_drain(self, name):
+        self.drain_ready.setdefault(name, False)
+
+    def drained(self, name):
+        return self.drain_ready.get(name, False)
+
+    async def remove(self, name):
+        self.coord.members = [
+            m for m in self.coord.members if m.name != name
+        ]
+
+
+class StubAdmission:
+    def __init__(self):
+        self.inflight = 0
+        self.queued = 0
+
+    def occupancy(self):
+        return self.inflight, self.queued
+
+
+def make_scaler(names=("m0",), **cfg_kw):
+    cfg = dict(min_members=1, max_members=4, interval_s=0.01,
+               up_queue=1, up_ticks=2, down_ticks=3,
+               loss_cooldown_s=30.0, drain_timeout_s=30.0)
+    cfg.update(cfg_kw)
+    coord = StubCoordinator(names)
+    adm = StubAdmission()
+    provider = StubProvider(coord)
+    scaler = Autoscaler(
+        coord, adm, provider=provider,
+        config=AutoscaleConfig(**cfg),
+        registry=MetricsRegistry(),
+        logger=Logger(verbose=0, stream=io.StringIO()),
+    )
+    return scaler, coord, adm, provider
+
+
+def actions(scaler):
+    return [d.action for d in scaler.decisions]
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_config_validation():
+    coord, adm = StubCoordinator(), StubAdmission()
+    with pytest.raises(ValueError):
+        Autoscaler(coord, adm, config=AutoscaleConfig(min_members=0),
+                   registry=MetricsRegistry())
+    with pytest.raises(ValueError):
+        Autoscaler(coord, adm,
+                   config=AutoscaleConfig(min_members=3, max_members=2),
+                   registry=MetricsRegistry())
+
+
+def test_config_from_settings(monkeypatch):
+    monkeypatch.setenv("FISHNET_TPU_AUTOSCALE_MIN", "2")
+    monkeypatch.setenv("FISHNET_TPU_AUTOSCALE_MAX", "6")
+    monkeypatch.setenv("FISHNET_TPU_AUTOSCALE_INTERVAL_MS", "250")
+    monkeypatch.setenv("FISHNET_TPU_AUTOSCALE_UP_TICKS", "3")
+    monkeypatch.setenv("FISHNET_TPU_AUTOSCALE_LOSS_COOLDOWN_S", "7")
+    cfg = AutoscaleConfig.from_settings()
+    assert cfg.min_members == 2
+    assert cfg.max_members == 6
+    assert cfg.interval_s == 0.25
+    assert cfg.up_ticks == 3
+    assert cfg.loss_cooldown_s == 7.0
+
+
+# -------------------------------------------------------------- hysteresis
+
+
+def test_scale_up_only_after_consecutive_pressure_ticks():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(up_ticks=2)
+        adm.queued = 2
+        await scaler.tick()  # streak 1 of 2: no action yet
+        assert scaler.stats.ups == 0 and len(coord.members) == 1
+        await scaler.tick()  # streak 2: scale up
+        assert scaler.stats.ups == 1
+        assert [m.name for m in coord.members] == ["m0", "auto0"]
+        assert actions(scaler) == ["up"]
+        # the streak resets after acting: one more pressure tick is not
+        # enough for a second member
+        await scaler.tick()
+        assert scaler.stats.ups == 1
+        await scaler.tick()
+        assert scaler.stats.ups == 2 and len(coord.members) == 3
+
+    asyncio.run(scenario())
+
+
+def test_quiet_tick_resets_pressure_streak():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(up_ticks=2)
+        adm.queued = 2
+        await scaler.tick()
+        adm.queued = 0
+        await scaler.tick()  # quiet: streak back to 0
+        adm.queued = 2
+        await scaler.tick()  # streak 1 again — still no up
+        assert scaler.stats.ups == 0 and len(coord.members) == 1
+        await scaler.tick()
+        assert scaler.stats.ups == 1
+
+    asyncio.run(scenario())
+
+
+def test_deadline_miss_counts_as_pressure():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(up_ticks=2)
+        miss = scaler.registry.counter(
+            "fishnet_slo_deadline_miss_total_analysis_t0", "test")
+        await scaler.tick()  # baseline snapshot of the miss counters
+        miss.inc()
+        await scaler.tick()  # delta 1: pressure streak 1
+        miss.inc()
+        await scaler.tick()  # delta 1 again: streak 2 -> up
+        assert scaler.stats.ups == 1
+        assert "misses=1" in scaler.decisions[0].reason
+
+    asyncio.run(scenario())
+
+
+def test_scale_up_clamped_at_max_members():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(max_members=2)
+        adm.queued = 5
+        for _ in range(8):
+            await scaler.tick()
+        assert len(coord.members) == 2
+        assert scaler.stats.ups == 1
+
+    asyncio.run(scenario())
+
+
+# -------------------------------------------------- scale-down and drains
+
+
+async def scale_up_one(scaler, adm):
+    adm.queued = 2
+    await scaler.tick()
+    await scaler.tick()
+    assert scaler.stats.ups == 1
+    adm.queued = 0
+
+
+def test_scale_down_drains_then_removes():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(down_ticks=3)
+        await scale_up_one(scaler, adm)
+        for _ in range(3):
+            await scaler.tick()
+        # down decision taken: the member is draining, not yet removed
+        assert scaler.stats.downs == 1
+        assert scaler.snapshot()["draining"] == "auto0"
+        assert len(coord.members) == 2
+        # drain still pending: the loop takes NO other structural
+        # decision, even under fresh pressure (serialization)
+        adm.queued = 10
+        for _ in range(4):
+            await scaler.tick()
+        assert scaler.stats.ups == 1 and scaler.stats.downs == 1
+        adm.queued = 0
+        # drain completes -> removed on the next tick
+        provider.drain_ready["auto0"] = True
+        await scaler.tick()
+        assert [m.name for m in coord.members] == ["m0"]
+        assert scaler.snapshot()["draining"] is None
+        assert scaler.snapshot()["owned"] == []
+        assert actions(scaler) == ["up", "down", "removed"]
+
+    asyncio.run(scenario())
+
+
+def test_floor_members_are_never_drained():
+    async def scenario():
+        # two configured members, floor 1, nothing autoscaler-owned:
+        # idleness alone must never shrink the hand-built fleet
+        scaler, coord, adm, provider = make_scaler(
+            names=("m0", "m1"), down_ticks=2)
+        for _ in range(10):
+            await scaler.tick()
+        assert scaler.stats.downs == 0
+        assert len(coord.members) == 2
+
+    asyncio.run(scenario())
+
+
+def test_drain_stall_reported_once_and_never_abandoned():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(
+            down_ticks=2, drain_timeout_s=0.0)
+        await scale_up_one(scaler, adm)
+        await scaler.tick()
+        await scaler.tick()  # down: begin drain (deadline already past)
+        assert scaler.stats.downs == 1
+        await scaler.tick()  # overdue -> drain-stalled, reported once
+        await scaler.tick()
+        await scaler.tick()
+        assert actions(scaler).count("drain-stalled") == 1
+        assert len(coord.members) == 2  # work is never abandoned
+        provider.drain_ready["auto0"] = True
+        await scaler.tick()
+        assert actions(scaler)[-1] == "removed"
+        assert len(coord.members) == 1
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------ loss-cooldown veto
+
+
+def test_member_loss_blocks_scale_down():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(
+            down_ticks=2, loss_cooldown_s=30.0)
+        await scale_up_one(scaler, adm)
+        coord.stats.losses += 1  # loss lands mid-idle
+        for _ in range(6):
+            await scaler.tick()
+        # every would-be down is vetoed while the cooldown window is
+        # open; the idle streak resets each time (re-earn idleness)
+        assert scaler.stats.downs == 0
+        assert scaler.stats.downs_blocked >= 1
+        assert "down-blocked" in actions(scaler)
+        assert len(coord.members) == 2
+        assert scaler.recovery_ladder_active()
+
+    asyncio.run(scenario())
+
+
+def test_scale_down_resumes_after_cooldown_expires():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(
+            down_ticks=2, loss_cooldown_s=0.05)
+        await scale_up_one(scaler, adm)
+        coord.stats.losses += 1
+        await scaler.tick()  # observes the loss, opens the veto window
+        await asyncio.sleep(0.1)
+        assert not scaler.recovery_ladder_active()
+        await scaler.tick()
+        await scaler.tick()
+        assert scaler.stats.downs == 1
+
+    asyncio.run(scenario())
+
+
+def test_ladder_state_blocks_scale_down():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(down_ticks=2)
+        await scale_up_one(scaler, adm)
+        # a member sitting on the recovery ladder is the same veto as a
+        # fresh loss event — capacity holds until it clears
+        coord.members[0].lifecycle = "probing"
+        await scaler.tick()
+        await scaler.tick()
+        assert scaler.stats.downs == 0
+        assert scaler.stats.downs_blocked == 1
+        coord.members[0].lifecycle = "serving"
+        await scaler.tick()
+        await scaler.tick()
+        assert scaler.stats.downs == 1
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------------- accounting
+
+
+def test_member_seconds_accrue_with_member_count():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler(names=("m0", "m1"))
+        await scaler.tick()
+        await asyncio.sleep(0.05)
+        await scaler.tick()
+        elapsed = scaler.stats.member_seconds
+        assert elapsed >= 2 * 0.05 * 0.5  # 2 members x wall-clock
+        snap = scaler.registry.snapshot()
+        assert snap["fishnet_autoscale_member_seconds_total"] == \
+            pytest.approx(elapsed, abs=1e-6)
+        assert snap["fishnet_autoscale_members"] == 2
+        assert snap["fishnet_autoscale_floor"] == 1
+        assert snap["fishnet_autoscale_ceiling"] == 4
+
+    asyncio.run(scenario())
+
+
+def test_snapshot_shape():
+    async def scenario():
+        scaler, coord, adm, provider = make_scaler()
+        await scaler.tick()
+        snap = scaler.snapshot()
+        assert snap["members"] == 1
+        assert snap["floor"] == 1 and snap["ceiling"] == 4
+        assert snap["owned"] == [] and snap["draining"] is None
+        assert snap["ticks"] == 1
+        assert snap["decisions"] == []
+
+    asyncio.run(scenario())
+
+
+# ----------------------------------------------------------- bit identity
+
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+
+def _py_chunk(n=4, depth=2):
+    import time as _time
+
+    from fishnet_tpu.client.ipc import Chunk, WorkPosition
+    from fishnet_tpu.client.wire import (
+        AnalysisWork,
+        EngineFlavor,
+        NodeLimit,
+    )
+
+    work = AnalysisWork(
+        id="asjob001",
+        nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0, depth=depth, multipv=None,
+    )
+    positions = [
+        WorkPosition(work=work, position_index=i, url=None, skip=False,
+                     root_fen=START, moves=["e2e4"])
+        for i in range(n)
+    ]
+    return Chunk(work=work, deadline=_time.monotonic() + 30.0,
+                 variant="standard", flavor=EngineFlavor.OFFICIAL,
+                 positions=positions)
+
+
+def _comparable(res):
+    from fishnet_tpu.client.ipc import response_to_wire
+
+    wire = response_to_wire(res)
+    return {k: wire[k]
+            for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+
+
+def test_capacity_changes_never_alter_answers():
+    """The whole contract in one pass: answers from a 1-member fleet,
+    the same fleet scaled up by the autoscaler, and the fleet scaled
+    back down to the floor are bit-identical to a direct engine run —
+    through the real FleetCoordinator membership path the
+    LocalProcessProvider uses, not a stub."""
+
+    async def scenario():
+        direct = await PyEngine(max_depth=2).go_multiple(_py_chunk())
+
+        coord = FleetCoordinator(
+            [FleetMember(name="base0", engine=PyEngine(max_depth=2))],
+            logger=Logger(verbose=0, stream=io.StringIO()),
+            registry=MetricsRegistry(),
+            loss_window=0.1,
+            local_factory=lambda name: FleetMember(
+                name=name, engine=PyEngine(max_depth=2)),
+        )
+        adm = StubAdmission()
+        scaler = Autoscaler(
+            coord, adm,
+            config=AutoscaleConfig(min_members=1, max_members=2,
+                                   up_ticks=2, down_ticks=2,
+                                   loss_cooldown_s=0.01),
+            registry=MetricsRegistry(),
+            logger=Logger(verbose=0, stream=io.StringIO()),
+        )
+        try:
+            at_floor = await coord.go_multiple(_py_chunk())
+
+            adm.queued = 4
+            await scaler.tick()
+            await scaler.tick()
+            assert len(coord.members) == 2
+            scaled_up = await coord.go_multiple(_py_chunk())
+
+            adm.queued = 0
+            for _ in range(8):
+                await scaler.tick()
+                if len(coord.members) == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(coord.members) == 1
+            back_down = await coord.go_multiple(_py_chunk())
+        finally:
+            await coord.close()
+
+        for fleet_run in (at_floor, scaled_up, back_down):
+            assert [r.position_index for r in fleet_run] == [0, 1, 2, 3]
+            for a, b in zip(fleet_run, direct):
+                assert _comparable(a) == _comparable(b)
+
+    asyncio.run(scenario())

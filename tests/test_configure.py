@@ -116,3 +116,37 @@ def test_backoff_growth_and_cap():
     assert delay <= 5.0
     b.reset()
     assert 0.1 <= b.next() <= 0.4
+
+
+def test_autoscale_flags_merge():
+    # unset anywhere: tri-state None defers to FISHNET_TPU_AUTOSCALE
+    cfg = merge(build_parser().parse_args(["serve"]), {})
+    assert cfg.autoscale is None
+    assert cfg.autoscale_min is None and cfg.autoscale_max is None
+
+    args = build_parser().parse_args(
+        ["serve", "--autoscale", "--autoscale-min", "2",
+         "--autoscale-max", "6"])
+    cfg = merge(args, {})
+    assert cfg.autoscale is True
+    assert cfg.autoscale_min == 2 and cfg.autoscale_max == 6
+
+    # --no-autoscale beats an ini that turns it on
+    args = build_parser().parse_args(["serve", "--no-autoscale"])
+    cfg = merge(args, {"autoscale": "1", "autoscale_min": "3"})
+    assert cfg.autoscale is False
+    assert cfg.autoscale_min == 3  # clamp still threads through
+
+    # ini alone can enable or disable
+    assert merge(build_parser().parse_args(["serve"]),
+                 {"autoscale": "1"}).autoscale is True
+    assert merge(build_parser().parse_args(["serve"]),
+                 {"autoscale": "off"}).autoscale is False
+
+
+def test_fleet_ctl_json_flag():
+    cfg = merge(build_parser().parse_args(["fleet-ctl", "list"]), {})
+    assert cfg.json_output is False
+    cfg = merge(
+        build_parser().parse_args(["fleet-ctl", "list", "--json"]), {})
+    assert cfg.json_output is True

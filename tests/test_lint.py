@@ -1129,3 +1129,59 @@ def test_breaking_an_invariant_fails_the_gate(tmp_path):
     assert set(rules_of(result.active)) == {
         "config-env-read", "config-env-unregistered",
     }
+
+
+# ------------------------------------------------- loadgen scope extension
+
+
+LOADGEN_SCOPED_BAD = '''
+import asyncio
+import time
+
+
+async def fire(sock):
+    time.sleep(0.5)                     # conc-sock-in-loop
+    t0 = time.time()                    # obs-wall-clock
+    while True:                         # conc-unbounded-retry
+        try:
+            return await asyncio.open_connection("h", 80), t0
+        except OSError:
+            await asyncio.sleep(0.1)
+'''
+
+
+def test_loadgen_is_inside_conc_and_obs_scope(tmp_path):
+    """tools/loadgen.py fires the open-loop schedule from inside the
+    serve event loop, so it carries the same async-hygiene and
+    clock-discipline contracts as the serve/fleet packages — the scope
+    extension must catch a careless edit there."""
+    project = make_project(tmp_path, {"tools/loadgen.py": LOADGEN_SCOPED_BAD})
+    result = run_lint(project, only_families={"concurrency", "obs"})
+    found = rules_of(result.findings)
+    assert "conc-sock-in-loop" in found
+    assert "conc-unbounded-retry" in found
+    assert "obs-wall-clock" in found
+
+
+def test_other_tools_stay_out_of_scope(tmp_path):
+    # the extension is surgical: one file, not the tools/ directory
+    project = make_project(
+        tmp_path, {"tools/hop_probe.py": LOADGEN_SCOPED_BAD})
+    result = run_lint(project, only_families={"concurrency", "obs"})
+    assert rules_of(result.findings) == []
+
+
+def test_autoscaler_is_inside_fleet_conc_scope(tmp_path):
+    # fishnet_tpu/fleet/ covers autoscaler.py by directory prefix; a
+    # blocking call inside its control loop must be flagged
+    src = '''
+import time
+
+
+async def tick():
+    time.sleep(1.0)
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/autoscaler.py": src})
+    result = run_lint(project, only_families={"concurrency"})
+    assert "conc-sock-in-loop" in rules_of(result.findings)

@@ -1,0 +1,266 @@
+"""Load-generator tests: schedule determinism, traffic shapes, Zipf
+tenant skew, record/replay round-trips, report math, and the open-loop
+firing engine against a scripted in-process HTTP stub.
+
+Everything runs on the loopback or pure functions — no serve stack, no
+subprocesses, no JAX.
+"""
+import asyncio
+import json
+
+from tools.loadgen import (
+    KindStats,
+    LoadProfile,
+    LoadReport,
+    PlannedRequest,
+    generate_schedule,
+    load_schedule,
+    rate_at,
+    request_body,
+    run_load,
+    save_schedule,
+)
+
+# ----------------------------------------------------------- determinism
+
+
+def test_same_seed_same_schedule():
+    profile = LoadProfile(pattern="flash", duration_s=5.0, base_rps=8.0)
+    assert generate_schedule(profile, 7) == generate_schedule(profile, 7)
+
+
+def test_different_seed_different_schedule():
+    profile = LoadProfile(pattern="steady", duration_s=5.0, base_rps=8.0)
+    assert generate_schedule(profile, 1) != generate_schedule(profile, 2)
+
+
+def test_schedule_sorted_and_within_duration():
+    profile = LoadProfile(pattern="diurnal", duration_s=6.0, base_rps=10.0)
+    schedule = generate_schedule(profile, 3)
+    assert schedule, "a 6s run at 10rps must schedule something"
+    ats = [r.at for r in schedule]
+    assert ats == sorted(ats)
+    assert all(0.0 <= at < profile.duration_s for at in ats)
+
+
+# ------------------------------------------------------------- rate shapes
+
+
+def test_rate_steady_is_constant():
+    profile = LoadProfile(pattern="steady", base_rps=5.0, duration_s=10.0)
+    assert {rate_at(profile, t) for t in (0.0, 3.3, 9.9)} == {5.0}
+
+
+def test_rate_flash_window():
+    profile = LoadProfile(pattern="flash", base_rps=2.0, duration_s=10.0,
+                          flash_factor=10.0, flash_start=0.4, flash_len=0.2)
+    assert rate_at(profile, 3.9) == 2.0  # before the burst
+    assert rate_at(profile, 4.0) == 20.0  # burst opens at 40% of the run
+    assert rate_at(profile, 5.9) == 20.0
+    assert rate_at(profile, 6.0) == 2.0  # burst closes at 60%
+
+
+def test_rate_diurnal_bounded_by_peak_and_trough():
+    profile = LoadProfile(pattern="diurnal", base_rps=4.0,
+                          duration_s=10.0, diurnal_period_s=10.0)
+    rates = [rate_at(profile, t / 10.0) for t in range(100)]
+    assert max(rates) <= 4.0 * 1.75 + 1e-9
+    assert min(rates) >= 4.0 * 0.25 - 1e-9
+    # one full cycle actually swings: both extremes are approached
+    assert max(rates) > 4.0 * 1.6
+    assert min(rates) < 4.0 * 0.4
+
+
+def test_flash_burst_concentrates_arrivals():
+    profile = LoadProfile(pattern="flash", duration_s=10.0, base_rps=3.0,
+                          flash_factor=10.0, flash_start=0.4, flash_len=0.2)
+    schedule = generate_schedule(profile, 11)
+    burst = [r for r in schedule if 4.0 <= r.at < 6.0]
+    outside = [r for r in schedule if not (4.0 <= r.at < 6.0)]
+    # 2s at 30rps vs 8s at 3rps: the burst must dominate the run
+    assert len(burst) > len(outside)
+
+
+# ---------------------------------------------------------- tenants, kinds
+
+
+def test_zipf_skew_favors_low_ranks():
+    profile = LoadProfile(pattern="steady", duration_s=40.0, base_rps=20.0,
+                          tenants=4, zipf_s=1.2)
+    schedule = generate_schedule(profile, 5)
+    counts = {f"t{i}": 0 for i in range(4)}
+    for req in schedule:
+        counts[req.tenant] += 1
+    assert set(counts) == {"t0", "t1", "t2", "t3"}
+    assert counts["t0"] > counts["t1"] > counts["t3"]
+
+
+def test_bestmove_ratio_extremes():
+    profile = LoadProfile(pattern="steady", duration_s=5.0, base_rps=10.0,
+                          bestmove_ratio=0.0, positions=3)
+    schedule = generate_schedule(profile, 1)
+    assert all(r.kind == "analysis" and r.positions == 3 for r in schedule)
+
+    profile = LoadProfile(pattern="steady", duration_s=5.0, base_rps=10.0,
+                          bestmove_ratio=1.0, positions=3)
+    schedule = generate_schedule(profile, 1)
+    # bestmove is interactive: always a single position per request
+    assert all(r.kind == "bestmove" and r.positions == 1 for r in schedule)
+
+
+# ---------------------------------------------------------- record/replay
+
+
+def test_record_replay_round_trip(tmp_path):
+    profile = LoadProfile(pattern="flash", duration_s=8.0, base_rps=6.0)
+    schedule = generate_schedule(profile, 42)
+    path = tmp_path / "run.jsonl"
+    save_schedule(str(path), schedule)
+    assert load_schedule(str(path)) == schedule
+
+
+def test_load_schedule_sorts_and_defaults(tmp_path):
+    path = tmp_path / "captured.jsonl"
+    # a captured production log massaged into the replay shape: out of
+    # order, sparse fields, blank lines
+    path.write_text(
+        json.dumps({"at": 2.5, "kind": "bestmove", "tenant": "bot"})
+        + "\n\n"
+        + json.dumps({"at": 0.5}) + "\n"
+    )
+    schedule = load_schedule(str(path))
+    assert [r.at for r in schedule] == [0.5, 2.5]
+    assert schedule[0].kind == "analysis"
+    assert schedule[0].tenant == "t0"
+    assert schedule[0].positions == 1
+    assert schedule[1].kind == "bestmove"
+
+
+def test_request_body_pure_and_varied():
+    req = PlannedRequest(at=0.0, kind="analysis", tenant="t1",
+                         positions=2, depth=3, timeout_ms=4000)
+    assert request_body(req, 5) == request_body(req, 5)  # replay = same bytes
+    body = request_body(req, 5)
+    assert body["tenant"] == "t1" and body["depth"] == 3
+    assert len(body["positions"]) == 2
+    assert "level" not in body
+    # distinct indices give distinct move chains -> distinct fingerprints
+    assert request_body(req, 5) != request_body(req, 6)
+
+    bm = PlannedRequest(at=0.0, kind="bestmove", tenant="t0",
+                        positions=1, depth=1, timeout_ms=4000)
+    assert request_body(bm, 0)["level"] == 5
+
+
+# ------------------------------------------------------------- report math
+
+
+def test_kind_stats_percentiles():
+    stats = KindStats(latencies_ms=[float(v) for v in range(1, 101)])
+    assert stats.percentile(0.50) == 51.0
+    assert stats.percentile(0.99) == 100.0
+    assert KindStats().percentile(0.99) == 0.0
+
+
+def test_report_rates():
+    report = LoadReport(duration_s=10.0, scheduled=40, ok=20, shed=10,
+                        errors=10)
+    assert report.achieved_rps == 2.0
+    assert report.shed_rate == 0.25
+    d = report.as_dict()
+    assert d["scheduled"] == 40 and d["shed_rate"] == 0.25
+    assert LoadReport().achieved_rps == 0.0
+    assert LoadReport().shed_rate == 0.0
+
+
+# ------------------------------------------------------------- run_load
+
+
+class StubServe:
+    """Minimal HTTP/1.1 stub that answers each POST with a scripted
+    status, recording the bodies it saw."""
+
+    def __init__(self, statuses):
+        self.statuses = list(statuses)
+        self.bodies = []
+        self.server = None
+
+    async def _handle(self, reader, writer):
+        raw = await reader.read(65536)
+        self.bodies.append(json.loads(raw.partition(b"\r\n\r\n")[2]))
+        status = self.statuses.pop(0) if self.statuses else 200
+        reason = {200: "OK", 429: "Too Many Requests"}.get(status, "Err")
+        body = b"{}"
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n".encode("latin-1") + body
+        )
+        await writer.drain()
+        writer.close()
+
+    async def start(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[:2]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def _tiny_schedule():
+    return [
+        PlannedRequest(at=0.0, kind="analysis", tenant="t0", positions=1,
+                       depth=1, timeout_ms=2000),
+        PlannedRequest(at=0.01, kind="bestmove", tenant="t1", positions=1,
+                       depth=1, timeout_ms=2000),
+        PlannedRequest(at=0.02, kind="analysis", tenant="t0", positions=1,
+                       depth=1, timeout_ms=2000),
+    ]
+
+
+def test_run_load_counts_every_outcome_exactly_once():
+    async def scenario():
+        stub = StubServe([200, 429, 500])
+        host, port = await stub.start()
+        seen = []
+        try:
+            report = await run_load(
+                host, port, _tiny_schedule(), drain_timeout_s=10.0,
+                on_result=lambda req, i, status, at: seen.append(
+                    (i, status)),
+            )
+        finally:
+            await stub.stop()
+        assert (report.ok, report.shed, report.errors) == (1, 1, 1)
+        assert report.scheduled == 3
+        assert report.duration_s > 0
+        assert sorted(i for i, _ in seen) == [0, 1, 2]
+        # each kind bucket saw its own outcomes
+        assert report.per_kind["analysis"].sent == 2
+        assert report.per_kind["bestmove"].sent == 1
+        # paths routed by kind: bestmove body carries its level
+        assert any("level" in b for b in stub.bodies)
+
+    asyncio.run(scenario())
+
+
+def test_run_load_transport_error_is_an_error_not_a_shed():
+    async def scenario():
+        # a server that accepts then slams the connection shut
+        async def handle(reader, writer):
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        schedule = _tiny_schedule()[:1]
+        try:
+            report = await run_load(host, port, schedule,
+                                    drain_timeout_s=5.0)
+        finally:
+            server.close()
+            await server.wait_closed()
+        assert (report.ok, report.shed, report.errors) == (0, 0, 1)
+
+    asyncio.run(scenario())

@@ -158,6 +158,69 @@ def test_admission_sheds_when_room_full():
     asyncio.run(scenario())
 
 
+def test_retry_after_with_no_drain_history_is_the_cap():
+    # a cold saturated server has no completion history to extrapolate
+    # from: the only honest Retry-After is the pessimistic cap
+    adm = AdmissionController(
+        max_inflight=4, max_queue=4, registry=MetricsRegistry()
+    )
+    assert adm.drain_rate() == 0.0
+    assert adm.retry_after() == 60
+    assert adm.retry_after(extra_positions=1) == 60
+
+
+def test_retry_after_zero_drain_stall_is_the_cap():
+    # a measured-then-collapsed drain rate (stall) must behave like no
+    # history at all — dividing by ~0 must not leak a huge number out
+    adm = AdmissionController(
+        max_inflight=8, max_queue=8, registry=MetricsRegistry()
+    )
+    adm._drain_rate = 0.0
+    assert adm.retry_after(extra_positions=100) == 60
+
+
+def test_retry_after_clamped_to_one_second_floor():
+    # backlog drains in well under a second: the header still says 1,
+    # never 0 (a 0 would invite an immediate retry storm)
+    adm = AdmissionController(
+        max_inflight=8, max_queue=8, registry=MetricsRegistry()
+    )
+    adm._drain_rate = 1000.0
+    assert adm.retry_after(extra_positions=1) == 1
+
+
+def test_retry_after_clamped_to_sixty_second_cap():
+    adm = AdmissionController(
+        max_inflight=8, max_queue=8, registry=MetricsRegistry()
+    )
+    adm._drain_rate = 0.5
+    assert adm.retry_after(extra_positions=10_000) == 60
+
+
+def test_retry_after_interior_estimate():
+    # 10 queued positions at 2 positions/s -> ~5s, +1 for the partial
+    adm = AdmissionController(
+        max_inflight=8, max_queue=8, registry=MetricsRegistry()
+    )
+    adm._drain_rate = 2.0
+    assert adm.retry_after(extra_positions=10) == 6
+
+
+def test_release_establishes_drain_rate():
+    async def scenario():
+        adm = AdmissionController(
+            max_inflight=4, max_queue=4, registry=MetricsRegistry()
+        )
+        ticket = await adm.admit(
+            "a", 2, time.monotonic() + 30.0, PRIORITY_BATCH)
+        await asyncio.sleep(0.01)
+        adm.release(ticket, ok=True)
+        assert adm.drain_rate() > 0.0
+        assert 1 <= adm.retry_after(extra_positions=4) <= 60
+
+    asyncio.run(scenario())
+
+
 def test_admission_sheds_expired_deadline():
     async def scenario():
         adm = AdmissionController(

@@ -363,3 +363,40 @@ def test_start_waits_for_ready():
             assert fake_cp(responses) == [FAKE_CP] * 2
 
     asyncio.run(main())
+
+
+def test_latency_jitter_deterministic_and_timing_only():
+    """--jitter-ms layers seeded uniform service-time jitter on top of
+    --latency-ms: the delay for chunk k is a pure function of
+    (--jitter-seed, k), so the test can compute the exact sleep the
+    host will take — and the answers are byte-identical to a
+    jitter-free run (the knob moves timing, never results)."""
+    import random as _random
+
+    async def main():
+        # replicate fakehost's draw for chunk 0 under seed 9
+        expected_s = _random.Random("9:0").uniform(0.0, 200.0) / 1000.0
+        cmd = fake_cmd({"chunks": ["ok"]}) + [
+            "--latency-ms", "50", "--jitter-ms", "200",
+            "--jitter-seed", "9",
+        ]
+        sup = SupervisedEngine(cmd, hb_interval=0.05, hb_timeout=1.0,
+                               deadline_margin=0.15,
+                               logger=Logger(verbose=0))
+        async with await closing(sup):
+            began = time.monotonic()
+            jittered = await sup.go_multiple(make_chunk(n_positions=2))
+            elapsed = time.monotonic() - began
+        # the scripted service delay really happened: fixed + jittered
+        assert elapsed >= 0.05 + expected_s
+        assert sup.stats.chunks_ok == 1
+
+        async with await closing(
+                make_supervisor({"chunks": ["ok"]})) as plain:
+            baseline = await plain.go_multiple(make_chunk(n_positions=2))
+
+        assert fake_cp(jittered) == fake_cp(baseline) == [FAKE_CP] * 2
+        assert [r.best_move for r in jittered] == \
+            [r.best_move for r in baseline]
+
+    asyncio.run(main())

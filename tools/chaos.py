@@ -42,6 +42,19 @@ with one 400ms straggler, hedge off then on: hedging must cut p99
 chunk latency, keep every position exactly-once, count its wins in
 fleet_hedges_total/fleet_hedge_wins_total, and stay bit-identical.
 
+`--scenario burst-member-loss` and `--scenario flap-under-load` are
+the elastic-capacity gates (ISSUE 16) — chaos UNDER load.
+burst-member-loss fires an open-loop 10x flash crowd
+(tools/loadgen.py) against a two-member-floor fleet with the
+autoscaler on, one floor member dying mid-burst: zero lost requests
+(every arrival answers or sheds), sheds bounded to the burst window,
+exactly one loss event, no scale-down inside the post-loss cooldown,
+and the member count must return to the floor once the burst passes.
+flap-under-load streams steady open-loop traffic while a FlakyProxy
+member refuses connections twice — a window inside the retry budget
+(zero losses) and one past it (losses naming only the proxied member)
+— and every scheduled request must still answer 200.
+
 `--scenario request-trace` is the request-tracing acceptance gate
 (ISSUE 14): a request POSTed to /analyse on a ServeApp fronting that
 same 3-member dying fleet must leave ONE merged Chrome trace linking
@@ -1164,6 +1177,370 @@ async def request_trace_scenario(args) -> int:
     return 0
 
 
+async def burst_member_loss_scenario(args) -> int:
+    """Elastic-capacity chaos gate (ISSUE 16): chaos UNDER load. An
+    open-loop flash crowd (tools/loadgen.py, 10x base rate) hits a
+    ServeApp whose fleet starts at its two-member floor with the
+    autoscaler running; floor member m0 dies mid-burst. The gate
+    demands the properties docs/autoscaling.md promises:
+
+    - zero lost requests: every scheduled arrival answers 200 or is
+      shed with a 429 — nothing hangs, nothing errors;
+    - bounded shed window: any shed lands inside the flash crowd (plus
+      drain slack), never after the autoscaler has caught up;
+    - exactly one loss event for the one death;
+    - no scale-DOWN decision inside the post-loss cooldown window (the
+      recovery-ladder veto — capacity never shrinks mid-ladder);
+    - the member count returns to the floor once the burst passes, so
+      the scale-up is hysteretic, not a ratchet.
+    """
+    import os
+
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.fleet import FleetCoordinator
+    from fishnet_tpu.fleet.autoscaler import AutoscaleConfig, Autoscaler
+    from fishnet_tpu.fleet.member import make_local_member
+    from fishnet_tpu.obs import metrics as obs_metrics
+    from fishnet_tpu.serve.server import ServeApp
+    from tools.loadgen import LoadProfile, generate_schedule, run_load
+
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="chaos-burst-") as tmp:
+
+        def member(name, script):
+            return make_local_member(
+                name,
+                host_cmd=[
+                    sys.executable, "-m", "fishnet_tpu.engine.fakehost",
+                    "--script", json.dumps(script),
+                    "--state", f"{tmp}/{name}.json",
+                    "--hb-interval", "0.05",
+                    # steady per-chunk service time: the flash crowd
+                    # must actually queue for the autoscaler to see it
+                    "--latency-ms", "30",
+                ],
+                logger=Logger(verbose=0),
+                hb_interval=0.05,
+                hb_timeout=1.0,
+                backoff=RandomizedBackoff(max_s=0.05),
+            )
+
+        print("== burst-member-loss: flash crowd, floor member dies "
+              "mid-burst, autoscaler on ==")
+        # a 2-member floor: m0 dies once mid-chunk (its respawn
+        # incarnation is clean) and m1 absorbs the re-dispatch — a
+        # 1-member floor would strand in-flight work in the dead
+        # window, which is a deployment error, not a chaos finding.
+        # Every autoscaled member is clean
+        coord = FleetCoordinator(
+            [
+                member("m0", {"chunks": ["die-after:1", "ok"]}),
+                member("m1", {"chunks": ["ok"]}),
+            ],
+            logger=Logger(verbose=0),
+            registry=obs_metrics.MetricsRegistry(),
+            redispatch_max=3, loss_window=0.2,
+            local_factory=lambda name: member(name, {"chunks": ["ok"]}),
+        )
+        app = ServeApp(
+            EngineSession(coord, flavor=EngineFlavor.TPU),
+            # a tiny admission section so the burst visibly queues:
+            # queued>0 is the autoscaler's up-pressure signal.
+            # max_inflight/max_queue count POSITIONS — inflight must fit
+            # at least one whole 4-position request or nothing admits
+            max_inflight=4, max_queue=64,
+            logger=Logger(verbose=0),
+            registry=obs_metrics.MetricsRegistry(),
+        )
+        as_cfg = AutoscaleConfig(
+            min_members=2, max_members=4, interval_s=0.15,
+            up_queue=1, up_ticks=2, down_ticks=5,
+            loss_cooldown_s=2.0, drain_timeout_s=20.0,
+        )
+        autoscaler = Autoscaler(
+            coord, app.admission, config=as_cfg,
+            registry=app.registry, logger=Logger(verbose=0),
+        )
+        # 4 positions per request: the coordinator splits a request
+        # across members, so m0's share of its first dispatch is >= 2
+        # positions and "die-after:1" lands MID-sub-chunk — a real
+        # member-loss event, not an idle death the supervisor absorbs
+        profile = LoadProfile(
+            pattern="flash", duration_s=8.0, base_rps=2.0,
+            flash_factor=10.0, flash_start=0.125, flash_len=0.375,
+            tenants=3, bestmove_ratio=0.0, positions=4, depth=1,
+            timeout_ms=20000,
+        )
+        schedule = generate_schedule(profile, seed=16)
+        flash_t0 = profile.flash_start * profile.duration_s
+        flash_t1 = flash_t0 + profile.flash_len * profile.duration_s
+        shed_offsets = []
+        loss_seen_at = [None]
+        run_began = [0.0]
+
+        def on_tick(t):
+            # first observation of the loss, on the loadgen clock
+            if loss_seen_at[0] is None and coord.stats.losses > 0:
+                loss_seen_at[0] = time.monotonic()
+
+        def on_result(req, index, status, at):
+            if status == 429:
+                shed_offsets.append(at)
+
+        try:
+            await coord.start()
+            host, port = await app.start("127.0.0.1", 0)
+            autoscaler.start()
+            run_began[0] = time.monotonic()
+            report = await run_load(
+                host, port, schedule, logger=Logger(verbose=0),
+                drain_timeout_s=60.0, on_tick=on_tick,
+                on_result=on_result,
+            )
+            # post-burst: wait for the loop to drain back to the floor
+            # (down_ticks idle ticks per step + one drain per member)
+            floor_deadline = time.monotonic() + 30.0
+            while time.monotonic() < floor_deadline:
+                snap = autoscaler.snapshot()
+                if (snap["members"] == as_cfg.min_members
+                        and snap["draining"] is None):
+                    break
+                await asyncio.sleep(0.1)
+            snap = autoscaler.snapshot()
+        finally:
+            await autoscaler.stop()
+            await app.drain_and_stop()
+            await coord.close()
+
+        d = report.as_dict()
+        print(f"load: {d['scheduled']} scheduled, {d['ok']} ok, "
+              f"{d['shed']} shed, {d['errors']} errors; "
+              f"p99={d['per_kind'].get('analysis', {}).get('p99_ms', 0)}ms")
+        print(f"autoscale: ups={snap['ups']} downs={snap['downs']} "
+              f"blocked={snap['downs_blocked']} members={snap['members']} "
+              f"member_seconds={snap['member_seconds']}")
+        print(f"fleet: losses={coord.stats.losses}")
+
+        if report.errors:
+            problems.append(
+                f"burst-member-loss: {report.errors} request(s) lost "
+                "(neither answered nor shed) — chaos under load dropped "
+                "work"
+            )
+        if report.ok == 0:
+            problems.append("burst-member-loss: no request succeeded")
+        if coord.stats.losses != 1:
+            problems.append(
+                "burst-member-loss: expected exactly one loss event, "
+                f"got {coord.stats.losses}"
+            )
+        if shed_offsets:
+            # sheds may only happen while the flash crowd outruns
+            # capacity: inside the burst plus a catch-up slack
+            first, last = min(shed_offsets), max(shed_offsets)
+            slack = 2.0
+            if first < flash_t0 - 0.1 or last > flash_t1 + slack:
+                problems.append(
+                    "burst-member-loss: shed window "
+                    f"[{first:.2f}, {last:.2f}]s escaped the flash "
+                    f"window [{flash_t0:.2f}, {flash_t1:.2f}]s (+"
+                    f"{slack:.0f}s slack) — capacity never caught up"
+                )
+        if snap["ups"] < 1:
+            problems.append(
+                "burst-member-loss: the autoscaler never scaled up "
+                "under a 10x flash crowd"
+            )
+        if snap["members"] != as_cfg.min_members or snap["owned"]:
+            problems.append(
+                "burst-member-loss: member count did not return to the "
+                f"floor after the burst (members={snap['members']}, "
+                f"owned={snap['owned']})"
+            )
+        if loss_seen_at[0] is not None:
+            veto_until = (loss_seen_at[0] - run_began[0]
+                          + as_cfg.loss_cooldown_s)
+            early_downs = [
+                dec for dec in autoscaler.decisions
+                if dec.action == "down"
+                and dec.at - run_began[0] < veto_until
+            ]
+            if early_downs:
+                problems.append(
+                    "burst-member-loss: a scale-down fired inside the "
+                    "post-loss cooldown window — the recovery-ladder "
+                    "veto failed"
+                )
+        else:
+            problems.append(
+                "burst-member-loss: the scripted member death was "
+                "never observed during the run"
+            )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos burst member loss::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos burst member loss: flash crowd survived a mid-burst "
+          "member death — zero lost requests, bounded shed window, "
+          "scale-up then return to floor, no scale-down mid-ladder")
+    return 0
+
+
+async def flap_under_load_scenario(args) -> int:
+    """Elastic-capacity chaos gate (ISSUE 16), flap half: the
+    fault-taxonomy guarantees of `fleet-flap` re-proven UNDER sustained
+    open-loop load instead of one chunk at a time. A steady loadgen
+    stream hits a ServeApp whose fleet is one PyEngine member plus one
+    remote member behind a FlakyProxy; mid-run the proxy refuses
+    connections twice:
+
+    - a refusal window SHORTER than the in-dispatch retry budget must
+      cost ZERO loss events — the bounded backoff rides it out while
+      traffic keeps flowing;
+    - a refusal window LONGER than the budget must surface as loss
+      events naming ONLY the proxied member, with the stranded
+      positions rerouted to the survivor;
+    - through both: every scheduled request answers 200 — zero errors,
+      zero sheds. Clients never see the flap; that is the graceful-
+      degradation contract docs/autoscaling.md and docs/fleet.md make.
+    """
+    from fishnet_tpu.engine.fakehost import FlakyProxy
+    from fishnet_tpu.engine.pyengine import PyEngine
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+    from fishnet_tpu.fleet.remote import HttpEngine
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+    from fishnet_tpu.serve.server import ServeApp
+    from tools.loadgen import LoadProfile, generate_schedule, run_load
+
+    problems = []
+
+    print("== flap-under-load: sustained open-loop stream, proxy "
+          "refuses twice (short, then long) ==")
+    # the proxied member's target: a plain serve front-end over PyEngine
+    backend = ServeApp(
+        EngineSession(PyEngine(max_depth=2), flavor=EngineFlavor.OFFICIAL),
+        registry=MetricsRegistry(), logger=Logger(verbose=0),
+    )
+    bhost, bport = await backend.start("127.0.0.1", 0)
+    proxy = FlakyProxy(bhost, bport)
+    phost, pport = await proxy.start()
+    remote = FleetMember(
+        name="proxy",
+        engine=HttpEngine(f"http://{phost}:{pport}", retry_max=4),
+        kind="remote",
+    )
+    coord = FleetCoordinator(
+        [remote, FleetMember(name="cpu0", engine=PyEngine(max_depth=2))],
+        logger=Logger(verbose=0), registry=MetricsRegistry(),
+        loss_window=0.3, redispatch_max=3,
+    )
+    app = ServeApp(
+        EngineSession(coord, flavor=EngineFlavor.OFFICIAL),
+        max_inflight=8, max_queue=64,
+        registry=MetricsRegistry(), logger=Logger(verbose=0),
+    )
+    host, port = await app.start("127.0.0.1", 0)
+
+    # steady 2 rps for 8s of single-position depth-1 requests: light
+    # enough that the 8s serve deadline cap is never the constraint —
+    # the flap, not the search, must be the only stressor
+    profile = LoadProfile(
+        pattern="steady", duration_s=8.0, base_rps=2.0, tenants=2,
+        bestmove_ratio=0.0, positions=1, depth=1, timeout_ms=8000,
+    )
+    schedule = generate_schedule(profile, seed=16)
+
+    # anchor each refusal window just ahead of a real scheduled
+    # arrival: the schedule is pure in (profile, seed), and an idle
+    # fleet's least-backlog tie-break dispatches to the FIRST member
+    # (the proxy), so a window that covers an arrival deterministically
+    # puts a connect attempt inside it
+    def arrival_after(t: float) -> float:
+        return next((p.at for p in schedule if p.at >= t), t)
+
+    short_at = max(arrival_after(1.5) - 0.1, 0.1)
+    long_at = arrival_after(short_at + 1.8) - 0.1
+
+    losses_after_short = [None]
+
+    async def inject():
+        # short refusal: inside the retry budget (min time-to-exhaust
+        # for retry_max=4 is ~0.38s of backoff, so 0.25s always rides)
+        await asyncio.sleep(short_at)
+        await proxy.set_fault("refuse-for:0.25")
+        await asyncio.sleep(1.4)
+        losses_after_short[0] = coord.stats.losses
+        # long refusal: past the budget (worst-case total backoff is
+        # ~1.1s, so a 1.5s window always exhausts) — a real loss
+        await asyncio.sleep(max(long_at - short_at - 1.4, 0.0))
+        await proxy.set_fault("refuse-for:1.5")
+
+    try:
+        injector = asyncio.ensure_future(inject())
+        report = await run_load(host, port, schedule,
+                                logger=Logger(verbose=0),
+                                drain_timeout_s=40.0)
+        await injector
+    finally:
+        await app.drain_and_stop()
+        await coord.close()
+        await proxy.close()
+        await backend.drain_and_stop()
+
+    print(f"load: scheduled={report.scheduled} ok={report.ok} "
+          f"shed={report.shed} errors={report.errors}")
+    print(f"fleet: losses={coord.stats.losses} "
+          f"retries={remote.engine.retries}")
+
+    if report.errors or report.shed or report.ok != report.scheduled:
+        problems.append(
+            "flap-under-load: the flap leaked to clients — "
+            f"ok={report.ok}/{report.scheduled} shed={report.shed} "
+            f"errors={report.errors} (all must answer 200)"
+        )
+    if losses_after_short[0] is None or losses_after_short[0] != 0:
+        problems.append(
+            "flap-under-load: a refusal shorter than the retry budget "
+            f"cost {losses_after_short[0]} loss event(s) — transient "
+            "connect faults must be ridden out in-dispatch"
+        )
+    if remote.engine.retries < 1:
+        problems.append(
+            "flap-under-load: the dispatch never retried (retries=0) — "
+            "the short refusal window was not exercised"
+        )
+    if coord.stats.losses < 1:
+        problems.append(
+            "flap-under-load: the long refusal never surfaced as a "
+            "loss event — the gate did not exercise re-dispatch"
+        )
+    wrong = [ev.member for ev in coord.loss_log if ev.member != "proxy"]
+    if wrong:
+        problems.append(
+            f"flap-under-load: loss events name {wrong!r} — only the "
+            "proxied member may be lost"
+        )
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos flap under load::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos flap under load: sustained stream rode out a short "
+          "refusal with zero losses, absorbed the long one as proxied-"
+          "member loss events, and every request answered 200")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos", description=__doc__,
@@ -1188,7 +1565,8 @@ def main(argv=None) -> int:
     p.add_argument("--probe-interval", type=float, default=5.0)
     p.add_argument("--scenario", nargs="?", const="ladder", default=None,
                    choices=["ladder", "fleet-member-loss", "request-trace",
-                            "fleet-flap", "fleet-straggler-hedge"],
+                            "fleet-flap", "fleet-straggler-hedge",
+                            "burst-member-loss", "flap-under-load"],
                    help="run an acceptance scenario and exit non-zero on "
                         "any delivery violation: `ladder` (default when "
                         "the flag is bare) is the session-recovery "
@@ -1217,6 +1595,10 @@ def main(argv=None) -> int:
         return asyncio.run(fleet_hedge_scenario(args))
     if args.scenario == "request-trace":
         return asyncio.run(request_trace_scenario(args))
+    if args.scenario == "burst-member-loss":
+        return asyncio.run(burst_member_loss_scenario(args))
+    if args.scenario == "flap-under-load":
+        return asyncio.run(flap_under_load_scenario(args))
     if args.trace_smoke:
         return asyncio.run(trace_smoke(args))
     return asyncio.run(replay(args))

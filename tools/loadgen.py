@@ -1,0 +1,499 @@
+"""Open-loop load generator for a live `fishnet-tpu serve` endpoint.
+
+Closed-loop clients (bench.py's serve rows, the chaos scenarios) wait
+for each response before sending the next request, so an overloaded
+server quietly throttles its own load and the measured latency looks
+fine right up to collapse. This tool is **open-loop**: arrival times
+are fixed on a pre-generated schedule and every request fires at its
+scheduled instant whether or not earlier ones have answered — exactly
+the coordinated-omission-free shape the autoscaler
+(fishnet_tpu/fleet/autoscaler.py) needs to be tested against.
+
+Traffic shapes (`--pattern`):
+
+  steady    constant `--rps`
+  diurnal   sinusoidal rate over `--diurnal-period` seconds (a whole
+            day compressed to the run: peak 1.75x base, trough 0.25x)
+  flash     constant base with a flash crowd of `--flash-factor` x base
+            between `--flash-start` and `--flash-start + --flash-len`
+            (fractions of the run)
+
+Per-tenant demand is heavy-tailed: tenants `t0..tN-1` draw Zipf
+weights 1/rank^s (`--zipf-s`), so t0 dominates the way one busy bot
+dominates a real multi-tenant front-end. A `--bestmove-ratio` slice of
+requests hits POST /bestmove (interactive priority); the rest POST
+/analyse (batch).
+
+Determinism and record/replay: the schedule is a pure function of the
+profile and `--seed` (one `random.Random(seed)`, no wall clock), so
+two runs with the same seed submit the identical request sequence.
+`--record FILE` writes the schedule as JSONL after the run;
+`--replay FILE` re-runs a recorded schedule byte-for-byte instead of
+generating one — captured production logs massaged into the same JSONL
+shape replay through the identical path.
+
+The report counts every scheduled request exactly once: 200 → ok,
+429 → shed (the admission controller refused it; open-loop means we do
+NOT retry — a retry loop here would silently convert the tool to
+closed-loop), anything else → error. Latency percentiles (p50/p99 per
+kind) are computed over answered requests only; achieved RPS and shed
+rate are reported against the scheduled total.
+
+Examples:
+    python -m tools.loadgen --port 9670 --pattern flash --rps 5 \
+        --flash-factor 10 --duration 20 --seed 7
+    python -m tools.loadgen --port 9670 --pattern diurnal --record run.jsonl
+    python -m tools.loadgen --port 9670 --replay run.jsonl --json
+
+docs/autoscaling.md shows the loadgen + autoscaler + chaos wiring;
+bench.py's `autoscale_flash` row and tools/chaos.py's
+burst-member-loss scenario drive the programmatic API
+(`generate_schedule` / `run_load`) in-process.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+import sys
+import time
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from fishnet_tpu.client.logger import Logger  # noqa: E402
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+
+# thinning-loop safety margin: the acceptance test `rate(t) <= peak`
+# must hold everywhere or arrivals silently thin to the wrong rate
+_PEAK_PAD = 1.001
+
+
+@dataclass(frozen=True)
+class LoadProfile:
+    """Shape of one open-loop run; `generate_schedule` is pure in
+    (profile, seed)."""
+
+    pattern: str = "steady"  # steady | diurnal | flash
+    duration_s: float = 10.0
+    base_rps: float = 5.0
+    flash_factor: float = 10.0
+    flash_start: float = 0.4  # fraction of duration
+    flash_len: float = 0.2  # fraction of duration
+    diurnal_period_s: float = 10.0
+    tenants: int = 4
+    zipf_s: float = 1.2
+    bestmove_ratio: float = 0.25
+    positions: int = 2  # per analyse request
+    depth: int = 1
+    timeout_ms: int = 8000
+
+
+@dataclass(frozen=True)
+class PlannedRequest:
+    """One scheduled arrival: fire at `at` seconds after run start."""
+
+    at: float
+    kind: str  # "analysis" | "bestmove"
+    tenant: str
+    positions: int
+    depth: int
+    timeout_ms: int
+
+
+def rate_at(profile: LoadProfile, t: float) -> float:
+    """Instantaneous arrival rate (req/s) at offset t."""
+    if profile.pattern == "diurnal":
+        phase = 2.0 * math.pi * t / max(profile.diurnal_period_s, 1e-9)
+        return profile.base_rps * (1.0 + 0.75 * math.sin(phase))
+    if profile.pattern == "flash":
+        start = profile.flash_start * profile.duration_s
+        end = start + profile.flash_len * profile.duration_s
+        if start <= t < end:
+            return profile.base_rps * profile.flash_factor
+        return profile.base_rps
+    return profile.base_rps
+
+
+def _peak_rate(profile: LoadProfile) -> float:
+    if profile.pattern == "diurnal":
+        return profile.base_rps * 1.75
+    if profile.pattern == "flash":
+        return profile.base_rps * max(profile.flash_factor, 1.0)
+    return profile.base_rps
+
+
+def _pick_tenant(rng: random.Random, weights: List[float]) -> int:
+    """Zipf draw by inverse CDF over precomputed cumulative weights."""
+    x = rng.random() * weights[-1]
+    lo, hi = 0, len(weights) - 1
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if weights[mid] < x:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+def generate_schedule(profile: LoadProfile, seed: int) -> List[PlannedRequest]:
+    """Poisson arrivals at rate(t) via Lewis-Shedler thinning; pure in
+    (profile, seed) — same inputs, same schedule, bit for bit."""
+    rng = random.Random(seed)
+    peak = _peak_rate(profile) * _PEAK_PAD
+    cum = []
+    total = 0.0
+    for rank in range(max(profile.tenants, 1)):
+        total += 1.0 / ((rank + 1) ** profile.zipf_s)
+        cum.append(total)
+    schedule: List[PlannedRequest] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= profile.duration_s:
+            break
+        if rng.random() * peak > rate_at(profile, t):
+            continue  # thinned: instantaneous rate below peak here
+        kind = ("bestmove" if rng.random() < profile.bestmove_ratio
+                else "analysis")
+        schedule.append(PlannedRequest(
+            at=round(t, 6),
+            kind=kind,
+            tenant=f"t{_pick_tenant(rng, cum)}",
+            positions=1 if kind == "bestmove" else profile.positions,
+            depth=profile.depth,
+            timeout_ms=profile.timeout_ms,
+        ))
+    return schedule
+
+
+def save_schedule(path: str, schedule: List[PlannedRequest]) -> None:
+    """One JSONL line per planned request — the replay format."""
+    with open(path, "w") as f:
+        for req in schedule:
+            f.write(json.dumps(asdict(req), sort_keys=True) + "\n")
+
+
+def load_schedule(path: str) -> List[PlannedRequest]:
+    """Read a `save_schedule` file (or a captured request log massaged
+    into the same JSONL shape) back into a schedule."""
+    schedule: List[PlannedRequest] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            schedule.append(PlannedRequest(
+                at=float(row["at"]),
+                kind=str(row.get("kind", "analysis")),
+                tenant=str(row.get("tenant", "t0")),
+                positions=int(row.get("positions", 1)),
+                depth=int(row.get("depth", 1)),
+                timeout_ms=int(row.get("timeout_ms", 8000)),
+            ))
+    schedule.sort(key=lambda r: r.at)
+    return schedule
+
+
+# one fixed legal line (closed Ruy Lopez): request_body slices prefixes
+# of it so position fingerprints VARY across requests — a single
+# repeated fen+moves would alias every request in the exactly-once
+# ledger and understate real multi-tenant churn
+_LINE = ["e2e4", "e7e5", "g1f3", "b8c6", "f1b5", "a7a6",
+         "b5a4", "g8f6", "e1g1", "f8e7", "f1e1", "b7b5"]
+
+
+def request_body(req: PlannedRequest, index: int) -> dict:
+    """The serve/protocol.py JSON body for one planned request.
+    Distinct move chains give distinct position fingerprints, so the
+    exactly-once ledger sees real entries, and the body is a pure
+    function of (req, index) — replay submits identical bytes."""
+    body = {
+        "id": f"lg-{index:06d}",
+        "tenant": req.tenant,
+        "positions": [
+            {"fen": START, "moves": _LINE[: (index + i) % (len(_LINE) + 1)]}
+            for i in range(req.positions)
+        ],
+        "depth": req.depth,
+        "timeout_ms": req.timeout_ms,
+    }
+    if req.kind == "bestmove":
+        body["level"] = 5
+    return body
+
+
+@dataclass
+class KindStats:
+    sent: int = 0
+    ok: int = 0
+    shed: int = 0
+    errors: int = 0
+    latencies_ms: List[float] = field(default_factory=list)
+
+    def percentile(self, q: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        ordered = sorted(self.latencies_ms)
+        idx = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[idx]
+
+
+@dataclass
+class LoadReport:
+    """Outcome of one open-loop run; `as_dict` is the --json shape."""
+
+    duration_s: float = 0.0
+    scheduled: int = 0
+    ok: int = 0
+    shed: int = 0
+    errors: int = 0
+    per_kind: Dict[str, KindStats] = field(default_factory=dict)
+
+    @property
+    def achieved_rps(self) -> float:
+        return self.ok / self.duration_s if self.duration_s > 0 else 0.0
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.scheduled if self.scheduled else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "duration_s": round(self.duration_s, 3),
+            "scheduled": self.scheduled,
+            "ok": self.ok,
+            "shed": self.shed,
+            "errors": self.errors,
+            "achieved_rps": round(self.achieved_rps, 3),
+            "shed_rate": round(self.shed_rate, 4),
+            "per_kind": {
+                kind: {
+                    "sent": s.sent,
+                    "ok": s.ok,
+                    "shed": s.shed,
+                    "errors": s.errors,
+                    "p50_ms": round(s.percentile(0.50), 1),
+                    "p99_ms": round(s.percentile(0.99), 1),
+                }
+                for kind, s in sorted(self.per_kind.items())
+            },
+        }
+
+
+async def _http_post(host: str, port: int, path: str, body: dict,
+                     timeout_s: float) -> int:
+    """One HTTP/1.1 POST over a raw asyncio connection (the serve
+    front-end speaks plain stdlib HTTP; no client library). Returns the
+    status code; the response body is drained and discarded."""
+
+    async def exchange() -> int:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            payload = json.dumps(body).encode("utf-8")
+            head = (
+                f"POST {path} HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + payload)
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        header = raw.partition(b"\r\n\r\n")[0]
+        return int(header.split(None, 2)[1])
+
+    return await asyncio.wait_for(exchange(), timeout=timeout_s)
+
+
+async def run_load(host: str, port: int, schedule: List[PlannedRequest],
+                   *, logger: Optional[Logger] = None,
+                   drain_timeout_s: float = 60.0,
+                   on_tick: Optional[Callable[[float], None]] = None,
+                   on_result: Optional[
+                       Callable[[PlannedRequest, int, Optional[int], float],
+                                None]] = None,
+                   ) -> LoadReport:
+    """Fire the schedule open-loop against host:port and report.
+
+    Every request launches at its scheduled offset regardless of
+    earlier requests' fates (one task per arrival — no shared
+    connection, no backpressure from slow responses). `on_tick(t)` is
+    called once per dispatched arrival with the current offset so a
+    caller can interleave chaos actions (kill a member at t=X) without
+    a second clock. `on_result(req, index, status, at)` fires as each
+    answer lands (status None on transport error, `at` the offset from
+    run start) — the chaos gates use it to bound WHEN sheds happened,
+    not just how many.
+    """
+    log = logger or Logger(verbose=0)
+    report = LoadReport(scheduled=len(schedule))
+    for req in schedule:
+        report.per_kind.setdefault(req.kind, KindStats())
+
+    async def fire(req: PlannedRequest, index: int) -> None:
+        stats = report.per_kind[req.kind]
+        stats.sent += 1
+        path = "/analyse" if req.kind == "analysis" else "/bestmove"
+        # per-request deadline: the scheduled timeout plus slack for
+        # queueing — bounded, never retried (open-loop contract)
+        budget_s = req.timeout_ms / 1000.0 + 30.0
+        began = time.monotonic()
+        try:
+            status = await _http_post(
+                host, port, path, request_body(req, index), budget_s)
+        except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+            stats.errors += 1
+            report.errors += 1
+            log.debug(f"loadgen: {path} #{index} failed: {e}")
+            if on_result is not None:
+                on_result(req, index, None, time.monotonic() - run_began)
+            return
+        elapsed_ms = (time.monotonic() - began) * 1000.0
+        if on_result is not None:
+            on_result(req, index, status, time.monotonic() - run_began)
+        if status == 200:
+            stats.ok += 1
+            stats.latencies_ms.append(elapsed_ms)
+            report.ok += 1
+        elif status == 429:
+            stats.shed += 1
+            report.shed += 1
+        else:
+            stats.errors += 1
+            report.errors += 1
+            log.debug(f"loadgen: {path} #{index} answered HTTP {status}")
+
+    run_began = time.monotonic()
+    tasks: List[asyncio.Future] = []
+    for index, req in enumerate(schedule):
+        delay = run_began + req.at - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if on_tick is not None:
+            on_tick(time.monotonic() - run_began)
+        tasks.append(asyncio.ensure_future(fire(req, index)))
+    if tasks:
+        done, pending = await asyncio.wait(tasks, timeout=drain_timeout_s)
+        for task in pending:
+            task.cancel()
+        if pending:
+            # a cancelled in-flight request is an error, not a shed
+            report.errors += len(pending)
+            log.warn(f"loadgen: {len(pending)} request(s) still in "
+                     f"flight after the {drain_timeout_s:.0f}s drain "
+                     "window; counted as errors")
+    report.duration_s = time.monotonic() - run_began
+    return report
+
+
+def profile_from_args(args: argparse.Namespace) -> LoadProfile:
+    return LoadProfile(
+        pattern=args.pattern,
+        duration_s=args.duration,
+        base_rps=args.rps,
+        flash_factor=args.flash_factor,
+        flash_start=args.flash_start,
+        flash_len=args.flash_len,
+        diurnal_period_s=args.diurnal_period,
+        tenants=args.tenants,
+        zipf_s=args.zipf_s,
+        bestmove_ratio=args.bestmove_ratio,
+        positions=args.positions,
+        depth=args.depth,
+        timeout_ms=args.timeout_ms,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="loadgen",
+        description="open-loop load generator for fishnet-tpu serve",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--pattern", default="steady",
+                   choices=["steady", "diurnal", "flash"])
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="run length in seconds")
+    p.add_argument("--rps", type=float, default=5.0,
+                   help="base arrival rate, requests/second")
+    p.add_argument("--flash-factor", type=float, default=10.0,
+                   help="flash pattern: burst multiplier over base rps")
+    p.add_argument("--flash-start", type=float, default=0.4,
+                   help="flash pattern: burst start, fraction of run")
+    p.add_argument("--flash-len", type=float, default=0.2,
+                   help="flash pattern: burst length, fraction of run")
+    p.add_argument("--diurnal-period", type=float, default=10.0,
+                   help="diurnal pattern: one full cycle, seconds")
+    p.add_argument("--tenants", type=int, default=4,
+                   help="tenant count; demand is Zipf over rank")
+    p.add_argument("--zipf-s", type=float, default=1.2,
+                   help="Zipf exponent for per-tenant demand")
+    p.add_argument("--bestmove-ratio", type=float, default=0.25,
+                   help="fraction of requests hitting POST /bestmove")
+    p.add_argument("--positions", type=int, default=2,
+                   help="positions per analyse request")
+    p.add_argument("--depth", type=int, default=1)
+    p.add_argument("--timeout-ms", type=int, default=8000)
+    p.add_argument("--seed", type=int, default=0,
+                   help="schedule seed; same seed, same schedule")
+    p.add_argument("--record", metavar="FILE",
+                   help="write the executed schedule as JSONL")
+    p.add_argument("--replay", metavar="FILE",
+                   help="run a recorded JSONL schedule instead of "
+                        "generating one (--pattern et al. ignored)")
+    p.add_argument("--drain-timeout", type=float, default=60.0,
+                   help="seconds to wait for in-flight requests after "
+                        "the last arrival")
+    p.add_argument("--json", action="store_true",
+                   help="print the report as JSON")
+    p.add_argument("--verbose", "-v", action="count", default=0)
+    args = p.parse_args(argv)
+
+    if args.replay:
+        schedule = load_schedule(args.replay)
+    else:
+        schedule = generate_schedule(profile_from_args(args), args.seed)
+    if args.record:
+        save_schedule(args.record, schedule)
+
+    logger = Logger(verbose=args.verbose)
+    if not args.json:
+        logger.headline(
+            f"loadgen: {len(schedule)} request(s) over "
+            f"{args.duration if not args.replay else 'replay'}"
+            f" → http://{args.host}:{args.port}"
+        )
+    report = asyncio.run(run_load(
+        args.host, args.port, schedule,
+        logger=logger, drain_timeout_s=args.drain_timeout,
+    ))
+    if args.json:
+        print(json.dumps(report.as_dict(), indent=2, sort_keys=True))
+    else:
+        d = report.as_dict()
+        print(f"scheduled={d['scheduled']} ok={d['ok']} shed={d['shed']} "
+              f"errors={d['errors']} achieved_rps={d['achieved_rps']} "
+              f"shed_rate={d['shed_rate']}")
+        for kind, row in d["per_kind"].items():
+            print(f"  {kind}: sent={row['sent']} ok={row['ok']} "
+                  f"shed={row['shed']} p50={row['p50_ms']}ms "
+                  f"p99={row['p99_ms']}ms")
+    return 0 if report.errors == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
