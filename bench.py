@@ -1298,6 +1298,161 @@ def run_autoscale_flash_stage(timeout: float) -> dict | None:
     }
 
 
+def run_cache_zipf_stage(timeout: float) -> dict | None:
+    """Analysis-cache row (ISSUE 17): a Zipf-distributed position
+    stream (tools/loadgen.py --fingerprint-dist zipf, s=1.1 — the
+    opening-theory-dominated population the cache is built for)
+    replayed closed-loop against an in-process ServeApp on the python
+    backend, cache off vs on. Three legs over ONE schedule:
+
+      cold  — cache off: every position is a real search (the
+              pre-cache baseline);
+      fill  — a fresh cache sees the same stream: the Zipf head starts
+              repeating mid-run (`first_pass_hit_ratio` is the benefit
+              a cache gets with NO warmup);
+      warm  — the same stream again on the filled cache: the steady
+              state of a long-running fleet.
+
+    The acceptance bar is warm >= 5x cold on effective positions/s;
+    the row also carries the hit ratio and resident bytes, and checks
+    every warm answer bit-identical (scores/pvs/best_move/depth/nodes)
+    to its cold twin. CPU-only, no JAX.
+
+    Knobs: BENCH_CACHE=0 skips; BENCH_CACHE_REQUESTS (default 40);
+    BENCH_CACHE_DEPTH (default 1 — keeps the python backend's search
+    in the tens of ms, big enough to dwarf a ~1ms hit, small enough
+    that the cold leg finishes in seconds)."""
+    import asyncio
+
+    from fishnet_tpu.cache.keys import engine_identity
+    from fishnet_tpu.cache.store import AnalysisCache
+    from fishnet_tpu.client.logger import Logger
+    from fishnet_tpu.client.wire import EngineFlavor
+    from fishnet_tpu.engine.pyengine import PyEngine
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+    from fishnet_tpu.serve.server import ServeApp
+    from tools.loadgen import LoadProfile, generate_schedule, request_body
+
+    n_requests = int(os.environ.get("BENCH_CACHE_REQUESTS", "40"))
+    depth = int(os.environ.get("BENCH_CACHE_DEPTH", "1"))
+    profile = LoadProfile(
+        pattern="steady", duration_s=60.0, base_rps=2.0,
+        tenants=3, bestmove_ratio=0.0, positions=2, depth=depth,
+        timeout_ms=30_000,
+        fingerprint_dist="zipf", fingerprint_pool=24,
+        fingerprint_zipf_s=1.1,
+    )
+    schedule = generate_schedule(profile, seed=42)[:n_requests]
+    bodies = [request_body(req, i) for i, req in enumerate(schedule)]
+    n_positions = sum(len(b["positions"]) for b in bodies)
+
+    async def http_post(host, port, payload_obj):
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            payload = json.dumps(payload_obj).encode("utf-8")
+            head = (
+                f"POST /analyse HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + payload)
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        header, _, body_bytes = raw.partition(b"\r\n\r\n")
+        status = int(header.decode("latin-1").split(None, 2)[1])
+        return status, (json.loads(body_bytes) if body_bytes else {})
+
+    def comparable(resp_body):
+        # the search-determined payload; wall-clock fields (time_s,
+        # nps, latency) legitimately differ between a cached answer
+        # and a fresh search
+        return [
+            {k: r.get(k)
+             for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+            for r in resp_body.get("results", [])
+        ]
+
+    async def replay(cache) -> dict:
+        """One closed-loop pass over the schedule; returns wall time
+        and the comparable answers keyed by request id."""
+        app = ServeApp(
+            EngineSession(PyEngine(max_depth=depth),
+                          flavor=EngineFlavor.OFFICIAL),
+            max_inflight=8, max_queue=16, default_timeout_ms=30_000,
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            cache=cache,
+        )
+        answers = {}
+        try:
+            host, port = await app.start("127.0.0.1", 0)
+            t0 = time.monotonic()
+            for body in bodies:
+                status, resp = await http_post(host, port, body)
+                if status != 200:
+                    raise RuntimeError(
+                        f"request {body['id']} answered {status}")
+                answers[body["id"]] = comparable(resp)
+            wall_s = max(time.monotonic() - t0, 1e-6)
+        finally:
+            await app.drain_and_stop()
+        return {"wall_s": wall_s, "answers": answers}
+
+    async def drive() -> dict:
+        cold = await replay(None)
+
+        ident = engine_identity(PyEngine(max_depth=depth),
+                                EngineFlavor.OFFICIAL)
+        cache = AnalysisCache(ident)  # memory-only: the row measures
+        fill = await replay(cache)    # the tier, not the sqlite sink
+        c_fill = cache.counters()
+        first_pass_ratio = c_fill["hit_ratio"]
+
+        warm = await replay(cache)
+        c_warm = cache.counters()
+        warm_hits = c_warm["hits"] - c_fill["hits"]
+        warm_total = warm_hits + (c_warm["misses"] - c_fill["misses"])
+
+        identical = all(
+            cold["answers"][rid] == warm["answers"][rid]
+            for rid in cold["answers"]
+        )
+        cold_pps = n_positions / cold["wall_s"]
+        warm_pps = n_positions / warm["wall_s"]
+        return {
+            "requests": len(bodies),
+            "positions": n_positions,
+            "depth": depth,
+            "pool": profile.fingerprint_pool,
+            "zipf_s": profile.fingerprint_zipf_s,
+            "cold_pos_per_s": round(cold_pps, 1),
+            "warm_pos_per_s": round(warm_pps, 1),
+            "speedup": round(warm_pps / max(cold_pps, 1e-9), 1),
+            "first_pass_hit_ratio": first_pass_ratio,
+            "warm_hit_ratio": round(
+                warm_hits / max(warm_total, 1), 4),
+            "entries": c_warm["entries"],
+            "bytes": c_warm["bytes"],
+            "coalesced": c_warm["coalesced"],
+            "bit_identical": identical,
+        }
+
+    try:
+        return asyncio.run(
+            asyncio.wait_for(drive(), timeout=min(timeout, 240.0)))
+    except (Exception, asyncio.TimeoutError) as e:
+        print(f"bench cache_zipf: run failed: {e}",
+              file=sys.stderr, flush=True)
+        return None
+
+
 def run_coldstart_stage(timeout: float) -> dict | None:
     """Cold-start A/B row (AOT program assets, fishnet_tpu/aot/):
     time-to-first-result of a FRESH engine process, plain JIT vs booted
@@ -1656,6 +1811,22 @@ def main() -> None:
             res = run_autoscale_flash_stage(min(stage_timeout, remaining))
             matrix["autoscale_flash"] = res
             print("bench config autoscale_flash: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
+    # analysis-cache row (ISSUE 17): a Zipf position stream replayed
+    # cache-off vs cache-on — the warm-vs-cold positions/s ratio is
+    # the memoization feature next to serve_latency's cold-path story
+    if os.environ.get("BENCH_CACHE", "1") != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 60.0:
+            print("bench: skipping cache_zipf (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["cache_zipf"] = None
+        else:
+            res = run_cache_zipf_stage(min(stage_timeout, remaining))
+            matrix["cache_zipf"] = res
+            print("bench config cache_zipf: "
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
 

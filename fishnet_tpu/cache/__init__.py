@@ -1,0 +1,48 @@
+"""Fleet-wide analysis memoization (docs/caching.md).
+
+Real traffic is heavily Zipf-skewed — opening positions, famous games
+and puzzle boards repeat across millions of users — yet a search is
+deterministic given (position, search shape, net): the same request
+always earns the same answer. This package never searches the same
+position twice:
+
+* `keys.py` — the ONE canonical cache-key builder: a content-only
+  position fingerprint (no chunk slot index) plus the normalized search
+  shape (kind, variant, multipv, effective node budget, level) and the
+  engine identity fingerprint (net + search-visible settings). The
+  depth axis stays OUT of the key: it is the satisfaction axis — a
+  cached depth-20 result answers a depth-12 request of the same shape,
+  never the reverse.
+* `store.py` — `AnalysisCache`: bounded in-memory LRU over wire-form
+  results, sqlite index + per-entry payload files via the
+  StatsRecorder plumbing (client/stats.py) so hits survive restarts,
+  sha256 integrity checks with an aot-registry-style quarantine
+  (`.bad` rename, one warning, fall back to a real search), and
+  in-flight coalescing so concurrent identical requests produce one
+  search and N deliveries.
+* `ttwarm.py` — hot transposition-table slices keyed by opening-prefix
+  fingerprint, spliced into the engine's shared TT when a chunk is
+  submitted, so even cache *misses* near known theory start warm.
+
+Consulted at two layers: serve admission (fishnet_tpu/serve/server.py —
+hits cost microseconds and shed no capacity) and the fleet coordinator
+(fishnet_tpu/fleet/coordinator.py — N members share one hit set).
+"""
+from .keys import (  # noqa: F401
+    DEPTH_DEFAULT,
+    CacheKey,
+    content_fingerprint,
+    engine_identity,
+    key_for_chunk_position,
+    key_for_request,
+    keys_for_requests,
+    satisfies,
+)
+from .store import (  # noqa: F401
+    AnalysisCache,
+    CacheStats,
+    attach_engine,
+    attach_ttwarm,
+    cache_from_settings,
+)
+from .ttwarm import TTWarmStore, prefix_fingerprint  # noqa: F401

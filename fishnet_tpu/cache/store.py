@@ -1,0 +1,546 @@
+"""The analysis-result cache: bounded LRU, sqlite-indexed persistence,
+integrity quarantine, and in-flight coalescing.
+
+Tiering (docs/caching.md):
+
+* **memory** — an OrderedDict LRU of wire-form results
+  (client/ipc.py response_to_wire dicts), bounded by entry count AND
+  byte size. A hit is a dict copy: microseconds, no search, no
+  admission capacity.
+* **disk** — when built with a cache directory: one payload file per
+  entry under `entries/`, indexed by the StatsRecorder sqlite sink
+  (client/stats.py `analysis_cache` table) with the payload's sha256.
+  Memory misses fall through to the index; a verified load promotes
+  the entry back into the LRU. Corruption quarantines EXACTLY that
+  entry — `.bad` rename, one warning, index row dropped — and the
+  request falls back to a real search (the same trust ladder as
+  aot/registry.py bundle loading).
+
+**Invalidation**: the engine identity fingerprint (keys.engine_identity)
+is pinned in the sqlite meta table. Opening a store persisted under a
+different net/settings fingerprint drops every entry with an explicit
+log line — a stale hit is never possible, because the fingerprint is
+also inside every key.
+
+**Exactly-once fill**: `store()` is idempotent — re-inserting a key at
+the same or shallower depth keeps the existing entry and counts
+`dup_fills`, so replayed, speculative and re-dispatched deliveries of
+the same result populate the cache once no matter how many paths race.
+
+**Coalescing**: `lease()` lets the serve layer attach a second
+identical request to the first's pending search (one search, N
+deliveries) — leaders settle an asyncio.Future the followers await.
+
+Thread-safety: lookups/fills arrive from the serve event loop, the
+fleet coordinator, and engine executor threads (the LaneScheduler
+delivery hook), so every mutation holds one lock. Raw writes outside
+this module are flagged by `cache-unkeyed-store` (lint/cache_rules.py).
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import asdict, dataclass
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..client.ipc import PositionResponse, responses_from_wire
+from ..client.logger import Logger
+from ..obs import metrics as obs_metrics
+from .keys import CacheKey, satisfies
+
+# per-tenant hit-ratio histogram buckets: a ratio in [0, 1], not the
+# registry's default millisecond scale
+RATIO_BUCKETS = (0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0)
+
+
+@dataclass
+class CacheStats:
+    """Plain counters; folded into the metrics registry by
+    export_metrics (same shape-contract as FleetStats)."""
+
+    hits: int = 0  # memory or verified-disk satisfaction
+    misses: int = 0
+    disk_hits: int = 0  # subset of hits that came off the index
+    fills: int = 0  # new entries (or deepened replacements)
+    dup_fills: int = 0  # idempotent re-inserts, kept existing
+    evictions: int = 0  # LRU evictions (memory tier)
+    coalesced: int = 0  # requests that joined a pending search
+    quarantined: int = 0  # corrupt payloads renamed .bad
+    invalidated: int = 0  # entries dropped on identity mismatch
+
+
+@dataclass
+class _Entry:
+    key: CacheKey
+    depth: int
+    wire: dict
+    nbytes: int
+
+
+@dataclass
+class _DiskRef:
+    row_id: str
+    depth: int
+    sha256: str
+    nbytes: int
+    filename: str
+
+
+class _Lease:
+    """Leader token for one pending search (see AnalysisCache.lease)."""
+
+    def __init__(self, cache: "AnalysisCache", key: CacheKey, depth: int):
+        self.cache = cache
+        self.key = key
+        self.depth = depth
+        self.future: "asyncio.Future[Optional[dict]]" = (
+            asyncio.get_running_loop().create_future()
+        )
+
+    def settle(self, wire: Optional[dict]) -> None:
+        """Resolve followers (None: the search failed; followers fall
+        back to their own search) and release the pending slot."""
+        self.cache._release_lease(self)
+        if not self.future.done():
+            self.future.set_result(wire)
+
+
+class AnalysisCache:
+    """One shared hit set for serve admission, the fleet coordinator
+    and the engine delivery hook."""
+
+    def __init__(
+        self,
+        net: str,
+        *,
+        max_entries: int = 4096,
+        max_bytes: int = 32 * 1024 * 1024,
+        directory: Optional[str] = None,
+        disk_max_entries: int = 65536,
+        recorder=None,
+        logger: Optional[Logger] = None,
+        registry: Optional[obs_metrics.MetricsRegistry] = None,
+    ) -> None:
+        self.net = net
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.disk_max_entries = int(disk_max_entries)
+        self.logger = logger or Logger()
+        self.registry = registry if registry is not None else obs_metrics.REGISTRY
+        self.stats = CacheStats()
+        self._lock = threading.Lock()
+        self._mem: "OrderedDict[CacheKey, _Entry]" = OrderedDict()
+        self._mem_bytes = 0
+        self._pending: Dict[CacheKey, Dict[int, _Lease]] = {}
+        self._dir: Optional[Path] = None
+        self._disk: Dict[CacheKey, _DiskRef] = {}
+        self.recorder = recorder
+        if directory is not None:
+            self._dir = Path(directory)
+            (self._dir / "entries").mkdir(parents=True, exist_ok=True)
+            if self.recorder is None:
+                from ..client.stats import StatsRecorder
+
+                self.recorder = StatsRecorder(
+                    stats_file=self._dir / "cache-stats.json",
+                    db_file=self._dir / "cache.db",
+                )
+        if self.recorder is not None and self.recorder.ensure_cache_tables():
+            self._open_persisted()
+        else:
+            self.recorder = None
+
+    # ----------------------------------------------------------- persistence
+
+    def _open_persisted(self) -> None:
+        """Load the sqlite index (payloads stay on disk until a miss
+        wants them), after the identity fingerprint gate."""
+        assert self.recorder is not None
+        persisted = self.recorder.cache_identity()
+        if persisted is not None and persisted != self.net:
+            dropped = self.recorder.cache_clear()
+            stale = (
+                (self._dir / "entries").glob("*.json") if self._dir else ()
+            )
+            for f in stale:
+                try:
+                    f.unlink()
+                except OSError:
+                    pass  # a locked/raced file only wastes disk, never serves
+            self.stats.invalidated += dropped
+            self.logger.warn(
+                f"cache: identity fingerprint changed "
+                f"({persisted} -> {self.net}); invalidated {dropped} "
+                f"persisted entr{'y' if dropped == 1 else 'ies'}"
+            )
+        self.recorder.set_cache_identity(self.net)
+        for row_id, key_json, depth, sha, nbytes, filename in \
+                self.recorder.cache_rows():
+            try:
+                key = CacheKey(*json.loads(key_json))
+            except (ValueError, TypeError):
+                self.recorder.cache_delete(row_id)
+                continue
+            if key.net != self.net:
+                # defense in depth: identity is in every key too
+                self.recorder.cache_delete(row_id)
+                continue
+            self._disk[key] = _DiskRef(row_id, int(depth), sha,
+                                       int(nbytes), filename)
+
+    def _payload_path(self, filename: str) -> Optional[Path]:
+        return (self._dir / "entries" / filename) if self._dir else None
+
+    def _load_disk(self, key: CacheKey, ref: _DiskRef) -> Optional[dict]:
+        """Verified payload load; corruption quarantines exactly this
+        entry (`.bad` rename, one warning) and reads as a miss."""
+        path = self._payload_path(ref.filename)
+        if path is None:
+            return None
+        try:
+            blob = path.read_bytes()
+        except OSError:
+            self._drop_disk(key, ref, why="payload file missing")
+            return None
+        if hashlib.sha256(blob).hexdigest() != ref.sha256:
+            self._quarantine(key, ref, path)
+            return None
+        try:
+            wire = json.loads(blob)
+        except ValueError:
+            self._quarantine(key, ref, path)
+            return None
+        return wire
+
+    def _quarantine(self, key: CacheKey, ref: _DiskRef, path: Path) -> None:
+        try:
+            os.replace(path, str(path) + ".bad")
+        except OSError:
+            pass  # rename raced a cleanup; the index row still goes
+        self._disk.pop(key, None)
+        if self.recorder is not None:
+            self.recorder.cache_delete(ref.row_id)
+        self.stats.quarantined += 1
+        self.logger.warn(
+            f"cache: integrity check failed for {ref.filename} "
+            f"(fp {key.fp}); quarantined to {ref.filename}.bad, "
+            "falling back to a real search"
+        )
+
+    def _drop_disk(self, key: CacheKey, ref: _DiskRef, why: str) -> None:
+        self._disk.pop(key, None)
+        if self.recorder is not None:
+            self.recorder.cache_delete(ref.row_id)
+        self.logger.debug(f"cache: dropped index row {ref.row_id}: {why}")
+
+    def _persist(self, entry: _Entry, blob: bytes) -> None:
+        if self.recorder is None or self._dir is None:
+            return
+        row_id = entry.key.row_id()
+        filename = f"{row_id}.json"
+        path = self._payload_path(filename)
+        assert path is not None
+        try:
+            tmp = path.with_suffix(".tmp")
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        except OSError as e:
+            self.logger.warn(f"cache: persist failed for {filename}: {e}")
+            return
+        sha = hashlib.sha256(blob).hexdigest()
+        self.recorder.cache_put(
+            row_id, json.dumps(list(entry.key)), entry.depth, sha,
+            entry.nbytes, filename,
+        )
+        self._disk[entry.key] = _DiskRef(row_id, entry.depth, sha,
+                                         entry.nbytes, filename)
+        dropped = set(self.recorder.cache_trim(self.disk_max_entries))
+        for name in dropped:
+            p = self._payload_path(name)
+            if p is not None:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass  # already gone; the index row was the bound
+        if dropped:
+            for k in [k for k, r in self._disk.items()
+                      if r.filename in dropped]:
+                del self._disk[k]
+
+    # ---------------------------------------------------------------- lookup
+
+    def lookup(self, key: CacheKey, depth: int) -> Optional[dict]:
+        """The satisfaction-gated read: a copy of the stored wire dict
+        when (same shape key) AND (cached depth satisfies the wanted
+        depth), else None. Counts one hit or one miss."""
+        with self._lock:
+            return self._lookup_locked(key, depth)
+
+    def _lookup_locked(self, key: CacheKey, depth: int) -> Optional[dict]:
+        entry = self._mem.get(key)
+        if entry is not None and satisfies(entry.depth, depth):
+            self._mem.move_to_end(key)
+            self.stats.hits += 1
+            return dict(entry.wire)
+        ref = self._disk.get(key)
+        if ref is not None and satisfies(ref.depth, depth):
+            wire = self._load_disk(key, ref)
+            if wire is not None:
+                self._insert_mem(key, ref.depth, wire)
+                self.stats.hits += 1
+                self.stats.disk_hits += 1
+                return dict(wire)
+        self.stats.misses += 1
+        return None
+
+    def peek(self, key: CacheKey, depth: int) -> bool:
+        """lookup() without counters or promotion (bench/debug)."""
+        with self._lock:
+            if key in self._mem and satisfies(self._mem[key].depth, depth):
+                return True
+            return key in self._disk and satisfies(
+                self._disk[key].depth, depth
+            )
+
+    # ------------------------------------------------------------------ fill
+
+    def store(self, key: CacheKey, depth: int, wire: dict) -> str:
+        """Idempotent fill from a delivered result. Returns "inserted"
+        (new entry), "deepened" (replaced a shallower one) or "kept"
+        (an at-least-as-deep entry already exists — the re-dispatch /
+        replay / speculation dedup case)."""
+        if key.net != self.net:
+            # a foreign-identity result can never be served by this
+            # store; refuse rather than poison (docs/caching.md trust)
+            return "kept"
+        with self._lock:
+            existing = self._mem[key] if key in self._mem else None
+            ref = self._disk[key] if key in self._disk else None
+            if (existing is not None and satisfies(existing.depth, depth)) \
+                    or (ref is not None and satisfies(ref.depth, depth)):
+                self.stats.dup_fills += 1
+                return "kept"
+            status = (
+                "inserted" if existing is None and ref is None else "deepened"
+            )
+            entry = self._insert_mem(key, depth, dict(wire))
+            blob = json.dumps(entry.wire, sort_keys=True).encode("utf-8")
+            self._persist(entry, blob)
+            self.stats.fills += 1
+            return status
+
+    def _insert_mem(self, key: CacheKey, depth: int, wire: dict) -> _Entry:
+        nbytes = len(json.dumps(wire, sort_keys=True))
+        old = self._mem.pop(key, None)
+        if old is not None:
+            self._mem_bytes -= old.nbytes
+        entry = _Entry(key, depth, wire, nbytes)
+        self._mem[key] = entry
+        self._mem_bytes += nbytes
+        while self._mem and (
+            len(self._mem) > self.max_entries
+            or self._mem_bytes > self.max_bytes
+        ):
+            _, evicted = self._mem.popitem(last=False)
+            self._mem_bytes -= evicted.nbytes
+            self.stats.evictions += 1
+        return entry
+
+    # ------------------------------------------------------------ coalescing
+
+    def lease(self, key: CacheKey, depth: int):
+        """Attach-or-lead for one cold position. Returns
+        ("hit", wire) | ("join", future) | ("lead", lease):
+
+        * hit — a fill raced ahead; serve it.
+        * join — an at-least-as-deep search for this key is already in
+          flight; await the future for its wire result (None if the
+          leader's search failed — fall back to searching).
+        * lead — this request runs the search and MUST call
+          lease.settle(wire_or_None) when it resolves.
+
+        Must be called on the event loop (creates/returns futures).
+        A join counts as a miss PLUS a coalesced consult: capacity-wise
+        it behaves like a miss (the leader is doing a real search), it
+        just doesn't pay for its own."""
+        with self._lock:
+            wire = self._lookup_locked(key, depth)
+            if wire is not None:
+                return "hit", wire
+            by_depth = self._pending[key] if key in self._pending else None
+            if by_depth:
+                for pend_depth, lease in by_depth.items():
+                    if satisfies(pend_depth, depth):
+                        self.stats.coalesced += 1
+                        return "join", lease.future
+            lease = _Lease(self, key, depth)
+            self._pending.setdefault(key, {})[depth] = lease
+            return "lead", lease
+
+    def _release_lease(self, lease: _Lease) -> None:
+        with self._lock:
+            if lease.key in self._pending:
+                by_depth = self._pending[lease.key]
+                if lease.depth in by_depth and \
+                        by_depth[lease.depth] is lease:
+                    del by_depth[lease.depth]
+                if not by_depth:
+                    del self._pending[lease.key]
+
+    # ------------------------------------------------------------- reporting
+
+    def counters(self) -> dict:
+        """Flat snapshot for /healthz and the bench RESULT rows."""
+        with self._lock:
+            total = self.stats.hits + self.stats.misses
+            return {
+                **asdict(self.stats),
+                "entries": len(self._mem),
+                "bytes": self._mem_bytes,
+                "disk_entries": len(self._disk),
+                "hit_ratio": round(self.stats.hits / total, 4) if total else 0.0,
+            }
+
+    def export_metrics(self) -> None:
+        """Mirror the counters into the metrics registry (hit/miss/
+        byte/evict gauges per the serving contract)."""
+        reg = self.registry
+        reg.absorb_totals("fishnet_cache", asdict(self.stats))
+        with self._lock:
+            entries, nbytes, disk = (
+                len(self._mem), self._mem_bytes, len(self._disk)
+            )
+        reg.gauge(
+            "fishnet_cache_entries", "Analysis-cache entries in memory"
+        ).set(entries)
+        reg.gauge(
+            "fishnet_cache_bytes", "Analysis-cache bytes in memory"
+        ).set(nbytes)
+        reg.gauge(
+            "fishnet_cache_disk_entries",
+            "Analysis-cache entries in the persisted index",
+        ).set(disk)
+
+    def observe_request(self, tenant: str, hits: int, total: int) -> None:
+        """Per-tenant hit-ratio histogram: one observation per served
+        request (0.0 all-cold .. 1.0 all-hit)."""
+        if total <= 0:
+            return
+        self.registry.histogram(
+            f"fishnet_cache_hit_ratio_{tenant}",
+            "Per-request analysis-cache hit ratio for this tenant",
+            buckets=RATIO_BUCKETS,
+        ).observe(hits / total)
+
+    # -------------------------------------------------------------- hydration
+
+    @staticmethod
+    def hydrate(
+        wire: dict,
+        position_index: Optional[int],
+        url: Optional[str] = None,
+        work=None,
+    ) -> PositionResponse:
+        """Stored wire dict → PositionResponse for THIS requester: the
+        payload's chunk-protocol bookkeeping (slot index, acme url)
+        belongs to whoever searched it first and is rewritten."""
+        out = dict(wire)
+        out["position_index"] = position_index
+        out["url"] = url
+        return responses_from_wire(work, [out])[0]
+
+
+# ----------------------------------------------------------------- wiring
+
+
+def cache_from_settings(
+    engine,
+    flavor,
+    *,
+    directory: Optional[str] = None,
+    logger: Optional[Logger] = None,
+    registry: Optional[obs_metrics.MetricsRegistry] = None,
+) -> Optional[AnalysisCache]:
+    """Build the AnalysisCache per FISHNET_TPU_CACHE* settings, keyed to
+    this engine's identity fingerprint; None when the cache is off.
+    An explicit `directory` (the --cache-dir flag) wins over the
+    FISHNET_TPU_CACHE_DIR / FISHNET_TPU_CACHE_PERSIST pair."""
+    from ..utils import settings as settings_mod
+    from .keys import engine_identity
+
+    if not settings_mod.get_bool("FISHNET_TPU_CACHE"):
+        return None
+    if directory is None and settings_mod.get_bool(
+        "FISHNET_TPU_CACHE_PERSIST"
+    ):
+        directory = settings_mod.get_str("FISHNET_TPU_CACHE_DIR") or str(
+            Path.home() / ".cache" / "fishnet-tpu" / "cache"
+        )
+    return AnalysisCache(
+        engine_identity(engine, flavor),
+        max_entries=settings_mod.get_int("FISHNET_TPU_CACHE_MAX_ENTRIES"),
+        max_bytes=settings_mod.get_int("FISHNET_TPU_CACHE_MAX_MB")
+        * 1024 * 1024,
+        directory=directory,
+        disk_max_entries=settings_mod.get_int(
+            "FISHNET_TPU_CACHE_DISK_MAX_ENTRIES"
+        ),
+        logger=logger,
+        registry=registry,
+    )
+
+
+def attach_engine(engine, cache: AnalysisCache) -> bool:
+    """Wire the exactly-once fill onto an engine's delivery path.
+
+    The hook rides LaneScheduler `_deliver` (engine/tpu.py) — the single
+    point every finalized response passes through exactly once, whether
+    it was searched, speculated, replayed or re-dispatched — so a result
+    populates the cache once no matter how it arrived. Chains any
+    previously installed hook. Returns False for engines without the
+    delivery hook (PyEngine subprocess path fills at the coordinator /
+    serve layer instead)."""
+    if not hasattr(engine, "on_deliver"):
+        return False
+    from ..client.ipc import response_to_wire
+    from .keys import key_for_chunk_position
+
+    prev = engine.on_deliver
+
+    def fill(chunk, wp, response) -> None:
+        if prev is not None:
+            prev(chunk, wp, response)
+        key, depth = key_for_chunk_position(chunk, wp, cache.net)
+        cache.store(key, depth, response_to_wire(response))
+
+    engine.on_deliver = fill
+    return True
+
+
+def attach_ttwarm(engine, *, logger: Optional[Logger] = None):
+    """Enable opening-prefix TT warm slices (cache/ttwarm.py) on an
+    engine per FISHNET_TPU_CACHE_TT*; returns the TTWarmStore or None
+    (off, or the engine has no shared table to warm)."""
+    from ..utils import settings as settings_mod
+    from .ttwarm import TTWarmStore
+
+    if not settings_mod.get_bool("FISHNET_TPU_CACHE_TT"):
+        return None
+    if not hasattr(engine, "tt_warm") or getattr(engine, "tt", None) is None:
+        return None
+    directory: Optional[str] = None
+    if settings_mod.get_bool("FISHNET_TPU_CACHE_PERSIST"):
+        directory = settings_mod.get_str("FISHNET_TPU_CACHE_DIR") or str(
+            Path.home() / ".cache" / "fishnet-tpu" / "cache"
+        )
+    store = TTWarmStore(directory=directory, logger=logger)
+    engine.tt_warm = store
+    engine.tt_warm_prefix = settings_mod.get_int(
+        "FISHNET_TPU_CACHE_TT_PREFIX"
+    )
+    return store

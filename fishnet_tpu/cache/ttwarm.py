@@ -1,0 +1,214 @@
+"""Hot transposition-table slices keyed by opening-prefix fingerprint.
+
+The result cache (store.py) only helps when the exact position repeats.
+Near known theory the *neighborhood* repeats: millions of games share
+the first N plies, then diverge. This module persists the TT rows the
+search earned around a position — the search root's slot plus the
+slots of its direct children (every depth-1 node of the subtree holds a
+near-root-depth entry) — keyed by the fingerprint of the opening
+prefix, and splices them back into the engine's shared table when a
+later chunk starts on the same prefix. A cache *miss* one novelty away
+from theory then begins with deep bounds and a best move already in the
+table instead of an empty slot.
+
+Safe by construction:
+
+* the zobrist tables (ops/tt.py Z1/Z2) come from a SEEDED PRNG, so a
+  slot index and check word computed in one process are valid in every
+  process with the same table size — slices survive restarts.
+* every TT entry is self-validating (`check = hash2 ^ meta ^ move`), so
+  a row spliced at the wrong slot — or a corrupt payload that slipped
+  past the sha256 gate — simply fails probe validation and costs a
+  re-search, never a wrong score. That is the same torn-write tolerance
+  the table already needs for lock-free batched scatters.
+* splicing only fills EMPTY slots (check == 0): a live deeper entry is
+  never clobbered by a persisted shallower one.
+
+Because warm-started searches may legitimately return different
+(better-informed) answers than cold ones, the feature is opt-in
+(FISHNET_TPU_CACHE_TT=0 by default) and sits outside the bit-identity
+guarantee of the result cache (docs/caching.md).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..client.logger import Logger
+
+# rows per slice: the root + up to this many child slots
+MAX_SLICE_ROWS = 48
+
+
+def prefix_fingerprint(root_fen: str, moves: Sequence[str],
+                       plies: int) -> str:
+    """Opening-prefix identity: the root FEN plus the first `plies`
+    moves. Positions reached through the same prefix share a slice even
+    after they diverge (the shared slots still validate; the divergent
+    ones read as misses)."""
+    h = hashlib.sha256()
+    h.update(root_fen.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(" ".join(list(moves)[:plies]).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+def extract_rows(slot_rows, slots: Sequence[int]) -> List[List[int]]:
+    """Non-empty TT rows from a gathered (len(slots), 4) row block —
+    the caller gathers `table.data[slots]` so only the slice crosses
+    from the device: [[slot, check, meta, move, gen], ...]."""
+    rows: List[List[int]] = []
+    seen = set()
+    for s, row in zip(slots, np.asarray(slot_rows)):
+        s = int(s)
+        if s in seen:
+            continue
+        seen.add(s)
+        if int(row[0]) != 0:
+            rows.append([s] + [int(v) for v in row])
+        if len(rows) >= MAX_SLICE_ROWS:
+            break
+    return rows
+
+
+def splice_rows(data, rows: Sequence[Sequence[int]]):
+    """Set persisted rows into a table, empty slots only; returns the
+    (possibly new) array and how many slots were written. Works on
+    jax arrays (functional .at[] update) — the engine swaps its TTable
+    for the result."""
+    if not rows:
+        return data, 0
+    n = data.shape[0]
+    slots = np.asarray([r[0] for r in rows], dtype=np.int64)
+    vals = np.asarray([r[1:] for r in rows], dtype=np.int32)
+    ok = (slots >= 0) & (slots < n)
+    slots, vals = slots[ok], vals[ok]
+    if slots.size == 0:
+        return data, 0
+    current = np.asarray(data[slots, 0])
+    empty = current == 0
+    slots, vals = slots[empty], vals[empty]
+    if slots.size == 0:
+        return data, 0
+    return data.at[slots].set(vals), int(slots.size)
+
+
+class TTWarmStore:
+    """Bounded LRU of TT slices + file persistence with the same
+    sha256-then-quarantine integrity ladder as the result store."""
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        max_entries: int = 512,
+        logger: Optional[Logger] = None,
+    ) -> None:
+        self.max_entries = int(max_entries)
+        self.logger = logger or Logger()
+        self._lock = threading.Lock()
+        self._mem: "OrderedDict[str, List[List[int]]]" = OrderedDict()
+        self.splices = 0
+        self.warm_slots = 0
+        self.exports = 0
+        self.quarantined = 0
+        self._dir: Optional[Path] = None
+        if directory is not None:
+            self._dir = Path(directory) / "tt"
+            self._dir.mkdir(parents=True, exist_ok=True)
+
+    def _mem_key(self, size_log2: int, key: str) -> str:
+        # slot indices are only meaningful at one table size
+        return f"{key}-{int(size_log2)}"
+
+    def _path(self, mem_key: str) -> Optional[Path]:
+        return (self._dir / f"{mem_key}.json") if self._dir else None
+
+    def lookup(self, size_log2: int, key: str) -> List[List[int]]:
+        mk = self._mem_key(size_log2, key)
+        with self._lock:
+            rows = self._mem[mk] if mk in self._mem else None
+            if rows is not None:
+                self._mem.move_to_end(mk)
+                return [list(r) for r in rows]
+            rows = self._load(mk)
+            if rows is None:
+                return []
+            self._insert(mk, rows)
+            return [list(r) for r in rows]
+
+    def record(self, size_log2: int, key: str,
+               rows: List[List[int]]) -> None:
+        """Persist a slice; merges with an existing one (new rows win
+        per slot — they come from a fresher search)."""
+        if not rows:
+            return
+        mk = self._mem_key(size_log2, key)
+        with self._lock:
+            merged = {
+                int(r[0]): list(r)
+                for r in (self._mem[mk] if mk in self._mem else [])
+            }
+            for r in rows:
+                merged[int(r[0])] = [int(v) for v in r]
+            out = list(merged.values())[:MAX_SLICE_ROWS]
+            self._insert(mk, out)
+            self.exports += 1
+            path = self._path(mk)
+            if path is not None:
+                blob = json.dumps(out, sort_keys=True).encode("utf-8")
+                payload = json.dumps({
+                    "sha256": hashlib.sha256(blob).hexdigest(),
+                    "rows": out,
+                }).encode("utf-8")
+                try:
+                    tmp = path.with_suffix(".tmp")
+                    tmp.write_bytes(payload)
+                    os.replace(tmp, path)
+                except OSError as e:
+                    self.logger.warn(f"cache: tt slice persist failed: {e}")
+
+    def _insert(self, mem_key: str, rows: List[List[int]]) -> None:
+        self._mem[mem_key] = rows
+        self._mem.move_to_end(mem_key)
+        while len(self._mem) > self.max_entries:
+            self._mem.popitem(last=False)
+
+    def _load(self, mem_key: str) -> Optional[List[List[int]]]:
+        path = self._path(mem_key)
+        if path is None or not path.exists():
+            return None
+        try:
+            payload = json.loads(path.read_bytes())
+            rows = payload["rows"]
+            blob = json.dumps(rows, sort_keys=True).encode("utf-8")
+            if hashlib.sha256(blob).hexdigest() != payload["sha256"]:
+                raise ValueError("sha mismatch")
+            return [[int(v) for v in r] for r in rows]
+        except (OSError, ValueError, TypeError, KeyError):
+            try:
+                os.replace(path, str(path) + ".bad")
+            except OSError:
+                pass  # rename raced a cleanup; treated as a miss either way
+            self.quarantined += 1
+            self.logger.warn(
+                f"cache: tt slice {path.name} failed integrity check; "
+                f"quarantined to {path.name}.bad"
+            )
+            return None
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "tt_slices": len(self._mem),
+                "tt_splices": self.splices,
+                "tt_warm_slots": self.warm_slots,
+                "tt_exports": self.exports,
+                "tt_quarantined": self.quarantined,
+            }

@@ -139,6 +139,11 @@ class Config:
     autoscale: Optional[bool] = None
     autoscale_min: Optional[int] = None
     autoscale_max: Optional[int] = None
+    # analysis-result cache (fishnet_tpu/cache/): --no-cache forces it
+    # off regardless of FISHNET_TPU_CACHE; cache_dir overrides
+    # FISHNET_TPU_CACHE_DIR for the persisted tier
+    cache: bool = True
+    cache_dir: Optional[str] = None
     # fleet-ctl: machine-readable output (`fleet-ctl --json list`)
     json_output: bool = False
     # AOT program assets (fishnet_tpu/aot/): `pack` builds a bundle,
@@ -236,6 +241,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--autoscale-max", type=int,
                    help="autoscaler member ceiling (default "
                         "FISHNET_TPU_AUTOSCALE_MAX)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="serve subcommand: disable the analysis-result "
+                        "cache (fishnet_tpu/cache/) even when "
+                        "FISHNET_TPU_CACHE is set")
+    p.add_argument("--cache-dir",
+                   help="serve subcommand: persisted-cache directory "
+                        "(default FISHNET_TPU_CACHE_DIR, "
+                        "~/.cache/fishnet-tpu/cache)")
     p.add_argument("--json", action="store_true", dest="json_output",
                    help="fleet-ctl list: print the raw health payload as "
                         "JSON instead of the human table")
@@ -354,6 +367,11 @@ def merge(args: argparse.Namespace, ini: dict) -> Config:
     cfg.autoscale_min = int(autoscale_min) if autoscale_min is not None else None
     autoscale_max = pick(args.autoscale_max, "autoscale_max")
     cfg.autoscale_max = int(autoscale_max) if autoscale_max is not None else None
+    cache_ini = str(ini.get("cache", "")).strip().lower()
+    cfg.cache = not (
+        args.no_cache or cache_ini in ("0", "false", "no", "off")
+    )
+    cfg.cache_dir = pick(args.cache_dir, "cache_dir")
     cfg.json_output = bool(args.json_output)
     cfg.aot_bundle = pick(args.aot_bundle, "aot_bundle")
     cfg.aot_dir = pick(args.aot_dir, "aot_dir")

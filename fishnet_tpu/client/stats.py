@@ -17,7 +17,7 @@ import sqlite3
 import time
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
-from typing import Optional
+from typing import List, Optional, Tuple
 
 
 @dataclass
@@ -201,6 +201,151 @@ class StatsRecorder:
             self._db.commit()
         except sqlite3.Error:
             pass
+
+    # ------------------------------------------------- analysis cache index
+    #
+    # The fleet-wide analysis cache (fishnet_tpu/cache/store.py) keeps
+    # its restart-surviving index here: one row per cached shape key
+    # pointing at a payload file whose sha256 the loader verifies
+    # (corruption quarantines the file with a `.bad` rename, mirroring
+    # aot/registry.py). cache_meta pins the engine identity fingerprint
+    # the entries were searched under — a mismatch at open invalidates
+    # the whole store (docs/caching.md).
+
+    def ensure_cache_tables(self) -> bool:
+        """Create the analysis-cache tables; False if no db sink."""
+        if self._db is None:
+            return False
+        try:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS analysis_cache ("
+                " row_id TEXT PRIMARY KEY,"
+                " timestamp INTEGER NOT NULL,"
+                " key TEXT NOT NULL,"
+                " depth INTEGER NOT NULL,"
+                " sha256 TEXT NOT NULL,"
+                " nbytes INTEGER NOT NULL,"
+                " filename TEXT NOT NULL)"
+            )
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS cache_meta ("
+                " key TEXT PRIMARY KEY,"
+                " value TEXT NOT NULL)"
+            )
+            self._db.commit()
+            return True
+        except sqlite3.Error:
+            return False
+
+    def cache_identity(self) -> Optional[str]:
+        if self._db is None:
+            return None
+        try:
+            row = self._db.execute(
+                "SELECT value FROM cache_meta WHERE key = 'identity'"
+            ).fetchone()
+            return row[0] if row else None
+        except sqlite3.Error:
+            return None
+
+    def set_cache_identity(self, identity: str) -> None:
+        if self._db is None:
+            return
+        try:
+            self._db.execute(
+                "INSERT OR REPLACE INTO cache_meta (key, value)"
+                " VALUES ('identity', ?)",
+                (identity,),
+            )
+            self._db.commit()
+        except sqlite3.Error:
+            pass
+
+    def cache_put(
+        self,
+        row_id: str,
+        key_json: str,
+        depth: int,
+        sha256: str,
+        nbytes: int,
+        filename: str,
+    ) -> None:
+        if self._db is None:
+            return
+        try:
+            self._db.execute(
+                "INSERT OR REPLACE INTO analysis_cache"
+                " (row_id, timestamp, key, depth, sha256, nbytes, filename)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+                # report timestamp (see record_metrics)
+                # fishnet-lint: disable=obs-wall-clock
+                (row_id, int(time.time()), key_json, depth, sha256,
+                 nbytes, filename),
+            )
+            self._db.commit()
+        except sqlite3.Error:
+            pass
+
+    def cache_rows(self) -> List[Tuple[str, str, int, str, int, str]]:
+        """The whole persisted index, oldest first:
+        (row_id, key_json, depth, sha256, nbytes, filename)."""
+        if self._db is None:
+            return []
+        try:
+            return list(self._db.execute(
+                "SELECT row_id, key, depth, sha256, nbytes, filename"
+                " FROM analysis_cache ORDER BY timestamp, row_id"
+            ))
+        except sqlite3.Error:
+            return []
+
+    def cache_delete(self, row_id: str) -> None:
+        if self._db is None:
+            return
+        try:
+            self._db.execute(
+                "DELETE FROM analysis_cache WHERE row_id = ?", (row_id,)
+            )
+            self._db.commit()
+        except sqlite3.Error:
+            pass
+
+    def cache_clear(self) -> int:
+        """Drop every persisted entry (identity invalidation); returns
+        how many rows were dropped."""
+        if self._db is None:
+            return 0
+        try:
+            n = self._db.execute(
+                "SELECT COUNT(*) FROM analysis_cache"
+            ).fetchone()[0]
+            self._db.execute("DELETE FROM analysis_cache")
+            self._db.commit()
+            return int(n)
+        except sqlite3.Error:
+            return 0
+
+    def cache_trim(self, max_entries: int) -> List[str]:
+        """Enforce the on-disk entry cap, oldest rows first; returns
+        the payload filenames of the dropped rows so the caller can
+        unlink them."""
+        if self._db is None or max_entries < 0:
+            return []
+        try:
+            rows = list(self._db.execute(
+                "SELECT row_id, filename FROM analysis_cache"
+                " ORDER BY timestamp DESC, row_id DESC"
+                " LIMIT -1 OFFSET ?", (max_entries,)
+            ))
+            if rows:
+                self._db.executemany(
+                    "DELETE FROM analysis_cache WHERE row_id = ?",
+                    [(r[0],) for r in rows],
+                )
+                self._db.commit()
+            return [r[1] for r in rows]
+        except sqlite3.Error:
+            return []
 
     def min_user_backlog(self) -> float:
         """Seconds of user-queue backlog below which this client should not

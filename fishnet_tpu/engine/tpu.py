@@ -299,6 +299,19 @@ class TpuEngine(ChunkSubmit):
         # engine/host.py points this at its `partial` frame emitter so
         # the supervisor's session journal sees incremental progress.
         self.on_response = None
+        # chunk-aware sibling of on_response, called as (chunk, wp,
+        # response) from the same exactly-once delivery point: the
+        # analysis cache (fishnet_tpu/cache/) fills from here, so
+        # speculative, replayed and re-dispatched results populate it
+        # once — the chunk carries the variant/work shape the cache key
+        # needs and the bare WorkPosition doesn't.
+        self.on_deliver = None
+        # TT warm slices (cache/ttwarm.py, FISHNET_TPU_CACHE_TT):
+        # when set, _submit splices persisted opening-prefix TT rows
+        # into the shared table before the chunk's refill jobs run, and
+        # run_chunk exports the rows the search earned back out.
+        self.tt_warm = None
+        self.tt_warm_prefix = 8
         # FISHNET_TPU_TRACE=1: per-dispatch / per-depth timing lines to
         # stderr (verdict A1: a hang or slow depth must be localizable
         # from logs — compile-vs-run shows up as a slow FIRST dispatch
@@ -1398,6 +1411,10 @@ class _ChunkEntry:
         self.responses: dict = {}  # position_index -> PositionResponse
         self.error: Optional[str] = None
         self.event = threading.Event()
+        # TT warm-slice plan (cache/ttwarm.py): (prefix key, slots)
+        # per position, filled by _submit when the engine has a warm
+        # store attached; run_chunk exports these slots on completion
+        self.tt_warm: list = []
 
 
 class LaneScheduler:
@@ -1451,7 +1468,78 @@ class LaneScheduler:
                 entry.event.wait(0.05)
         if entry.error:
             raise EngineError(entry.error)
+        if self.engine.tt_warm is not None and entry.tt_warm:
+            self._tt_warm_export(entry)
         return [entry.responses[wp.position_index] for wp in chunk.positions]
+
+    def _tt_warm_plan(self, entry: _ChunkEntry, wp, pos, variant) -> None:
+        """Opening-prefix TT warm-up (cache/ttwarm.py): compute the TT
+        slots of this position and its direct children, remember them on
+        the entry for export after the chunk, and splice any persisted
+        slice for the same prefix into the shared table. Splicing swaps
+        `eng.tt` and so only happens under the queue lock while no drive
+        loop is live (the drive loop re-reads `eng.tt` per segment and
+        writes it back in its `finally`, which would clobber a
+        concurrent swap); a busy engine just skips the warm start."""
+        from ..cache import ttwarm as cache_ttwarm
+        from ..ops import tt as tt_mod
+
+        eng = self.engine
+        store = eng.tt_warm
+        if store is None or eng.tt is None:
+            return
+        try:
+            key = cache_ttwarm.prefix_fingerprint(
+                wp.root_fen, wp.moves, eng.tt_warm_prefix
+            )
+            children = [pos.push(m) for m in pos.legal_moves()]
+            boards = [pos] + children[: cache_ttwarm.MAX_SLICE_ROWS - 1]
+            stacked = stack_boards([from_position(p) for p in boards])
+            h1, _h2 = tt_mod.hash_boards(stacked, variant)
+            mask = (1 << eng.tt_size_log2) - 1
+            slots = [int(h) & mask for h in np.asarray(h1)]
+            entry.tt_warm.append((key, slots))
+            rows = store.lookup(eng.tt_size_log2, key)
+            if not rows:
+                return
+            with self._q_lock:
+                tt = eng.tt
+                if (
+                    not self._driving
+                    and tt is not None
+                    and tt.data.ndim == 2
+                ):
+                    data, n = cache_ttwarm.splice_rows(tt.data, rows)
+                    if n:
+                        eng.tt = tt._replace(data=data)
+                        store.splices += 1
+                        store.warm_slots += n
+        except Exception as e:
+            eng._warn(f"tt warm plan failed: {e}")
+
+    def _tt_warm_export(self, entry: _ChunkEntry) -> None:
+        """After a chunk completes, read back the slots planned in
+        `_tt_warm_plan` from a table snapshot and persist the non-empty
+        rows. Reads a gathered slice from whatever `eng.tt` points at
+        now — rows from a later occupant of the same slot still
+        self-validate on splice, so staleness is safe."""
+        from ..cache import ttwarm as cache_ttwarm
+
+        eng = self.engine
+        store = eng.tt_warm
+        tt = eng.tt
+        if store is None or tt is None or tt.data.ndim != 2:
+            return
+        try:
+            for key, slots in entry.tt_warm:
+                idx = np.asarray(slots, dtype=np.int64)
+                rows = cache_ttwarm.extract_rows(
+                    np.asarray(tt.data[idx]), slots
+                )
+                if rows:
+                    store.record(eng.tt_size_log2, key, rows)
+        except Exception as e:
+            eng._warn(f"tt warm export failed: {e}")
 
     def _submit(self, chunk: Chunk) -> _ChunkEntry:
         eng = self.engine
@@ -1478,6 +1566,8 @@ class LaneScheduler:
                 )
                 continue
             hh, hm = TpuEngine._history_arrays([game], 1, variant)
+            if eng.tt_warm is not None:
+                self._tt_warm_plan(entry, wp, pos, variant)
             job = _RefillJob(
                 entry, wp, pos, from_position(pos), variant, target_depth,
                 per_pos_budget, deadline, hh[0], hm[0],
@@ -1534,6 +1624,12 @@ class LaneScheduler:
                 hook(wp, response)
             except Exception as e:
                 self.engine._warn(f"on_response hook failed: {e}")
+        deliver = self.engine.on_deliver
+        if deliver is not None:
+            try:
+                deliver(entry.chunk, wp, response)
+            except Exception as e:
+                self.engine._warn(f"on_deliver hook failed: {e}")
 
     def _finalize(self, job: _RefillJob, now: float,
                   error: Optional[str] = None) -> None:

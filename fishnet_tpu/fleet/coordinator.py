@@ -72,11 +72,14 @@ import time
 from dataclasses import asdict, dataclass, field, replace
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..cache.keys import key_for_chunk_position
+from ..cache.store import AnalysisCache
 from ..client.ipc import (
     Chunk,
     PositionResponse,
     WorkPosition,
     position_fingerprint,
+    response_to_wire,
     responses_from_wire,
 )
 from ..client.logger import Logger
@@ -165,6 +168,7 @@ class FleetCoordinator(ChunkSubmit):
         probation: Optional[bool] = None,
         cooldown_max: Optional[float] = None,
         local_factory=None,
+        cache: Optional[AnalysisCache] = None,
     ) -> None:
         if not members:
             raise ValueError("a fleet needs at least one member")
@@ -197,6 +201,10 @@ class FleetCoordinator(ChunkSubmit):
         # runtime `add_member("local")` builds through this (app.py
         # closes it over the Config; tests over a fakehost command line)
         self.local_factory = local_factory
+        # the fleet-shared analysis cache (fishnet_tpu/cache/): every
+        # member's delivered results land in ONE hit set, so member B
+        # never re-searches what member A already answered
+        self.cache = cache
         self.registry = registry or obs_metrics.REGISTRY
         self.fallback_factory = fallback_factory
         self.stats = FleetStats()
@@ -381,6 +389,11 @@ class FleetCoordinator(ChunkSubmit):
 
     # ---------------------------------------------------------------- health
 
+    def attach_cache(self, cache: AnalysisCache) -> None:
+        """Install the fleet-shared analysis cache after construction
+        (run_serve builds the coordinator before the cache exists)."""
+        self.cache = cache
+
     def health(self) -> dict:
         now = time.monotonic()
         members = [m.health(now) for m in self.members]
@@ -394,6 +407,9 @@ class FleetCoordinator(ChunkSubmit):
             "hedge_wins": self.stats.hedge_wins,
             "readmissions": self.stats.readmissions,
             "busy_reroutes": self.stats.busy_reroutes,
+            "cache": (
+                self.cache.counters() if self.cache is not None else None
+            ),
         }
 
     def fold_metrics(self) -> None:
@@ -419,6 +435,8 @@ class FleetCoordinator(ChunkSubmit):
             "Fleet members finishing in-flight work before removal",
         ).set(sum(1 for m in self.members if m.draining))
         reg.absorb_totals("fishnet_fleet", asdict(self.stats))
+        if self.cache is not None:
+            self.cache.export_metrics()
         # the hedging acceptance counters under their contract names
         # (docs/fleet.md): duplicates dispatched, duplicates that won
         reg.counter(
@@ -468,8 +486,37 @@ class FleetCoordinator(ChunkSubmit):
                 results[fp] = await self._go_quarantined(chunk, wp)
             else:
                 pending.append((fp, wp))
+        # fleet-shared cache consult (fishnet_tpu/cache/): a position
+        # ANY member already searched is served from the shared hit set
+        # and never dispatched — quarantined positions stay out (their
+        # fallback answers come from a different engine identity)
+        dispatched: List[_Pair] = pending
+        if self.cache is not None and pending:
+            cold: List[_Pair] = []
+            for fp, wp in pending:
+                key, depth = key_for_chunk_position(chunk, wp, self.cache.net)
+                wire = self.cache.lookup(key, depth)
+                if wire is not None:
+                    results[fp] = AnalysisCache.hydrate(
+                        wire, wp.position_index, url=wp.url
+                    )
+                else:
+                    cold.append((fp, wp))
+            pending = dispatched = cold
         if pending:
             await self._dispatch_all(chunk, pending, results)
+        if self.cache is not None:
+            # exactly-once fill off the ack journal: everything the
+            # dispatch rounds resolved — including results HARVESTED
+            # from a lost member's partial acks — lands in the shared
+            # set once (store() dedups replayed/re-dispatched copies)
+            for fp, wp in dispatched:
+                resp = results.get(fp)
+                if resp is not None:
+                    key, depth = key_for_chunk_position(
+                        chunk, wp, self.cache.net
+                    )
+                    self.cache.store(key, depth, response_to_wire(resp))
         missing = [fp for fp, _ in pairs if fp not in results]
         if missing:  # _dispatch_all raises before this can happen
             raise EngineError(

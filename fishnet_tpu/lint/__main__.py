@@ -62,6 +62,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         # importing run_lint's rule modules registers the families
         from . import aot_rules  # noqa: F401
+        from . import cache_rules  # noqa: F401
         from . import concurrency_rules  # noqa: F401
         from . import config_rules  # noqa: F401
         from . import obs_rules  # noqa: F401

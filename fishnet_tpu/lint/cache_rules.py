@@ -1,0 +1,105 @@
+"""Analysis-cache key discipline rules.
+
+A cache entry is only safe to serve when its key captures everything
+that changes the answer and normalizes everything that doesn't
+(fishnet_tpu/cache/keys.py: content-only fingerprint, raw multipv,
+EFFECTIVE node budget, engine identity). A `CacheKey(...)` hand-built
+anywhere else skips that normalization: the serve layer and the fleet
+coordinator stop agreeing on keys, which reads as a miss at best — and
+at worst stores an entry under a shape it doesn't answer, i.e. a stale
+hit. All key construction must route through the builders in
+fishnet_tpu/cache/keys.py (`key_for_chunk_position`,
+`keys_for_requests`, `key_for_request`).
+
+Rules:
+  cache-unkeyed-store  any call that resolves to the CacheKey
+                       constructor — through any import form of
+                       fishnet_tpu.cache / fishnet_tpu.cache.keys —
+                       in any package/tool file other than the cache
+                       package's own keys.py/store.py (store.py
+                       rebuilds keys from its persisted index).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project, SourceFile, dotted, register_family
+
+# the files allowed to construct CacheKey directly: the builders, and
+# the store (which reconstructs keys from its sqlite index rows)
+_ALLOWED = ("fishnet_tpu/cache/keys.py", "fishnet_tpu/cache/store.py")
+
+# module-path tails that mean "the cache package", across absolute and
+# relative import spellings
+_KEY_MODULE_TAILS = ("cache", "cache.keys")
+
+
+def _is_cache_module(module: str) -> bool:
+    return any(
+        module == tail or module.endswith("." + tail)
+        for tail in _KEY_MODULE_TAILS
+    )
+
+
+def _key_call_sites(src: SourceFile) -> List[ast.Call]:
+    """Every call in this file that resolves to the CacheKey
+    constructor, through any import form of the cache package."""
+    mod_aliases: Set[str] = set()  # alias -> the cache (sub)module
+    bare_names: Set[str] = set()  # from-imported CacheKey
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if _is_cache_module(alias.name):
+                    mod_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if _is_cache_module(module):
+                for alias in node.names:
+                    if alias.name == "CacheKey":
+                        bare_names.add(alias.asname or alias.name)
+                    elif alias.name == "keys":
+                        mod_aliases.add(alias.asname or alias.name)
+            else:
+                # `from fishnet_tpu import cache` / `from .. import cache`
+                for alias in node.names:
+                    if alias.name == "cache":
+                        mod_aliases.add(alias.asname or alias.name)
+
+    sites: List[ast.Call] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        head, _, tail = name.rpartition(".")
+        if name in bare_names:
+            sites.append(node)
+        elif tail == "CacheKey" and head and (
+            head in mod_aliases
+            or any(head.startswith(m + ".") for m in mod_aliases)
+            or _is_cache_module(head)
+        ):
+            sites.append(node)
+    return sites
+
+
+@register_family("cache")
+def check_cache_keyed_store(project: Project) -> List[Finding]:
+    """Cache keys stay behind the canonical builders."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu", "tools", "bench.py"):
+        if src.rel in _ALLOWED:
+            continue
+        for node in _key_call_sites(src):
+            findings.append(src.finding(
+                "cache-unkeyed-store", node,
+                "hand-built CacheKey outside cache/keys.py skips the "
+                "normalization the satisfaction rule depends on "
+                "(content fingerprint, effective node budget, engine "
+                "identity) — the serve and fleet layers stop agreeing "
+                "on keys and a stale hit becomes possible; build keys "
+                "via key_for_chunk_position / keys_for_requests",
+            ))
+    return findings

@@ -93,20 +93,22 @@ BLOCK_SCOPE = (
     "fishnet_tpu/serve",
     "fishnet_tpu/fleet",
     "fishnet_tpu/aot",
+    "fishnet_tpu/cache",
     "tools/loadgen.py",
 )
 
 # modules where a swallowed exception hides an operational failure
 EXCEPT_SCOPE = ("fishnet_tpu/client", "fishnet_tpu/engine",
                 "fishnet_tpu/serve", "fishnet_tpu/fleet",
-                "fishnet_tpu/aot", "tools/loadgen.py")
+                "fishnet_tpu/aot", "fishnet_tpu/cache",
+                "tools/loadgen.py")
 
 # these packages run inside ONE shared event loop: a blocking socket
 # call in an async def stalls every tenant (serve), every member
 # dispatch (fleet — the autoscaler control loop rides the same loop),
 # or every open-loop arrival (tools/loadgen.py) at once
 SERVE_ASYNC_SCOPE = ("fishnet_tpu/serve", "fishnet_tpu/fleet",
-                     "tools/loadgen.py")
+                     "fishnet_tpu/cache", "tools/loadgen.py")
 
 # call targets that block the thread: raw socket ops, sync HTTP
 # clients, and the sleep that should have been asyncio.sleep. Matched
@@ -125,7 +127,8 @@ _BLOCKING_IN_LOOP_TAILS = ("accept", "connect", "recv", "recv_into",
 # tools/loadgen.py is open-loop BY CONTRACT — a retry loop there would
 # silently convert it to closed-loop — so the same rule polices it
 RETRY_SCOPE = ("fishnet_tpu/fleet", "fishnet_tpu/serve",
-               "fishnet_tpu/client", "tools/loadgen.py")
+               "fishnet_tpu/client", "fishnet_tpu/cache",
+               "tools/loadgen.py")
 
 # awaited call tails that reach the network. Deliberately narrow:
 # `acquire`/`go_multiple` are absent so the work queue's long-poll

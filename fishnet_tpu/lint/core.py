@@ -184,6 +184,7 @@ def run_lint(
 ) -> LintResult:
     # rule modules self-register on import
     from . import aot_rules  # noqa: F401
+    from . import cache_rules  # noqa: F401
     from . import concurrency_rules  # noqa: F401
     from . import config_rules  # noqa: F401
     from . import obs_rules  # noqa: F401

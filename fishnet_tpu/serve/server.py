@@ -29,7 +29,11 @@ import signal
 import time
 from typing import Dict, Optional, Tuple
 
+from ..cache.keys import keys_for_requests
+from ..cache.store import AnalysisCache
+from ..client.ipc import response_to_wire
 from ..client.logger import Logger
+from ..client.wire import EngineFlavor
 from ..engine.base import EngineError
 from ..engine.session import EngineSession
 from ..obs import inflight as obs_inflight
@@ -90,11 +94,16 @@ class ServeApp:
         logger: Optional[Logger] = None,
         registry: Optional[obs_metrics.MetricsRegistry] = None,
         fleet=None,
+        cache: Optional[AnalysisCache] = None,
     ):
         self.session = session
         # the FleetCoordinator behind this front-end, when there is one:
         # enables the /fleet/members runtime-membership admin surface
         self.fleet = fleet
+        # the analysis-result cache (fishnet_tpu/cache/), consulted
+        # BEFORE admission: a hit costs microseconds and sheds no
+        # capacity; only cold positions pay for an admission ticket
+        self.cache = cache
         self.logger = logger or Logger()
         if max_inflight is None:
             max_inflight = settings.get_int("FISHNET_TPU_SERVE_MAX_INFLIGHT")
@@ -272,6 +281,9 @@ class ServeApp:
                 "inflight": inflight,
                 "queued": queued,
                 "drain_rate_pos_per_s": round(self.admission.drain_rate(), 3),
+                "cache": (
+                    self.cache.counters() if self.cache is not None else None
+                ),
             }, {}
         if path == "/debug/requests":
             if method != "GET":
@@ -374,33 +386,121 @@ class ServeApp:
                   if traced else obs_trace.NULL_SPAN):
                 if traced:
                     rec.flow("request", tid, "s")
+                preqs = to_position_requests(sreq, deadline, ctx=ctx)
+                n = len(preqs)
+                cache = self.cache
+                # cache consult (docs/caching.md): classify every
+                # position as hit (served from store), join (an
+                # identical search is already in flight — one search,
+                # N deliveries) or lead (cold; we search and fill)
+                hydrated: Dict[int, object] = {}
+                joins: Dict[int, "asyncio.Future"] = {}
+                leases: Dict[int, object] = {}
+                keys = None
+                if cache is not None:
+                    flavor = getattr(self.session, "flavor", EngineFlavor.TPU)
+                    keys = keys_for_requests(preqs, cache.net, flavor=flavor)
+                    for i, (key, depth) in enumerate(keys):
+                        state, val = cache.lease(key, depth)
+                        if state == "hit":
+                            hydrated[i] = AnalysisCache.hydrate(val, i)
+                        elif state == "join":
+                            joins[i] = val
+                        else:
+                            leases[i] = val
+                        if traced:
+                            rec.instant(
+                                "cache.hit" if state != "lead"
+                                else "cache.miss",
+                                "serve",
+                                **obs_trace.ctx_args(
+                                    ctx, position_index=i,
+                                    coalesced=state == "join",
+                                ))
+                cold = sorted(leases) if cache is not None else list(range(n))
+                fallback: list = []
                 try:
-                    with (rec.span("serve.admission", "serve",
-                                   **obs_trace.ctx_args(ctx))
-                          if traced else obs_trace.NULL_SPAN):
-                        ticket = await self.admission.admit(
-                            sreq.tenant, len(sreq.positions), deadline,
-                            sreq.priority,
+                    ticket = None
+                    if cold or cache is None:
+                        # only cold positions pay for admission: an
+                        # all-hit request never touches the waiting room
+                        try:
+                            with (rec.span("serve.admission", "serve",
+                                           **obs_trace.ctx_args(ctx))
+                                  if traced else obs_trace.NULL_SPAN):
+                                ticket = await self.admission.admit(
+                                    sreq.tenant,
+                                    len(cold) if cache is not None else n,
+                                    deadline, sreq.priority,
+                                )
+                        except Shed as e:
+                            self.slo.shed(sreq.tenant, sreq.kind)
+                            return 429, shed_to_json(
+                                e.retry_after, e.reason
+                            ), {"Retry-After": str(e.retry_after)}
+                    self.inflight.stage(tid, "admitted")
+                    queue_ms = (time.monotonic() - t0) * 1000.0
+                    ok = False
+                    try:
+                        self.inflight.stage(tid, "dispatched")
+                        searched = (
+                            await self.session.submit_many(
+                                [preqs[i] for i in cold]
+                            ) if cold else []
                         )
-                except Shed as e:
-                    self.slo.shed(sreq.tenant, sreq.kind)
-                    return 429, shed_to_json(e.retry_after, e.reason), {
-                        "Retry-After": str(e.retry_after)
-                    }
-                self.inflight.stage(tid, "admitted")
-                queue_ms = (time.monotonic() - t0) * 1000.0
-                ok = False
-                try:
-                    self.inflight.stage(tid, "dispatched")
-                    responses = await self.session.submit_many(
-                        to_position_requests(sreq, deadline, ctx=ctx)
-                    )
-                    ok = True
-                except EngineError as e:
-                    self.logger.error(f"serve: engine error: {e}")
-                    return 500, {"error": f"engine error: {e}"}, {}
+                        ok = True
+                    except EngineError as e:
+                        self.logger.error(f"serve: engine error: {e}")
+                        return 500, {"error": f"engine error: {e}"}, {}
+                    finally:
+                        if ticket is not None:
+                            self.admission.release(ticket, ok=ok)
+                    for i, resp in zip(cold, searched):
+                        hydrated[i] = resp
+                        if keys is not None:
+                            # fill + settle: followers coalesced onto
+                            # this search get the same wire result
+                            # (store() is idempotent — the engine-side
+                            # delivery hook may have filled already)
+                            wire = response_to_wire(resp)
+                            key, depth = keys[i]
+                            cache.store(key, depth, wire)
+                            leases[i].settle(dict(wire))
+                    for i, fut in joins.items():
+                        try:
+                            wire = await asyncio.wait_for(
+                                asyncio.shield(fut),
+                                timeout=max(
+                                    0.0, deadline - time.monotonic()
+                                ),
+                            )
+                        except (asyncio.TimeoutError,
+                                asyncio.CancelledError):
+                            wire = None
+                        if wire is None:
+                            # the leader's search failed or outran our
+                            # deadline: fall back to our own search
+                            fallback.append(i)
+                        else:
+                            hydrated[i] = AnalysisCache.hydrate(wire, i)
+                    if fallback:
+                        try:
+                            fb = await self.session.submit_many(
+                                [preqs[i] for i in fallback]
+                            )
+                        except EngineError as e:
+                            self.logger.error(f"serve: engine error: {e}")
+                            return 500, {"error": f"engine error: {e}"}, {}
+                        for i, resp in zip(fallback, fb):
+                            hydrated[i] = resp
                 finally:
-                    self.admission.release(ticket, ok=ok)
+                    if cache is not None:
+                        for lease in leases.values():
+                            # no-op for settled leases; an error path
+                            # resolves followers to None (search-your-
+                            # own) instead of wedging them
+                            lease.settle(None)
+                responses = [hydrated[i] for i in range(n)]
                 now = time.monotonic()
                 total_ms = (now - t0) * 1000.0
                 device_ms = max(
@@ -426,7 +526,16 @@ class ServeApp:
                             deadline_missed=now > deadline,
                         ))
                     rec.flow("request", tid, "f")
-                return 200, results_to_json(sreq, responses, now - t0), {}
+                extra: Dict[str, str] = {}
+                if cache is not None:
+                    served = n - len(cold) - len(fallback)
+                    extra["X-Fishnet-Cache"] = (
+                        "hit" if n and served == n
+                        else "partial" if served else "miss"
+                    )
+                    cache.observe_request(sreq.tenant, served, n)
+                    cache.export_metrics()
+                return 200, results_to_json(sreq, responses, now - t0), extra
         finally:
             self.inflight.end(tid)
             self._open_requests -= 1
@@ -486,9 +595,44 @@ async def run_serve(cfg) -> int:
             )
 
     session = EngineSession(engine, flavor=flavor)
+    cache = None
+    if getattr(cfg, "cache", True):
+        from ..cache import attach_ttwarm, cache_from_settings
+        from ..cache import attach_engine as cache_attach_engine
+
+        if getattr(cfg, "fleet", False):
+            # the coordinator object carries no net of its own: pin the
+            # identity inputs from the config its members are built with
+            # so the fingerprint tracks netswaps (cache/keys.py)
+            if getattr(engine, "weights_path", None) is None:
+                engine.weights_path = cfg.tpu_weights
+            if getattr(engine, "max_depth", None) is None:
+                engine.max_depth = cfg.tpu_depth
+        cache = cache_from_settings(
+            engine, flavor, logger=logger,
+            directory=getattr(cfg, "cache_dir", None),
+        )
+    if cache is not None:
+        logger.info(
+            f"serve: analysis cache on (identity {cache.net}, "
+            f"{'persisted' if cache.recorder is not None else 'memory-only'})"
+        )
+        if getattr(cfg, "fleet", False):
+            # fleet: consult + fill at the coordinator so N members
+            # share one hit set (exactly-once via the ack journal path)
+            engine.attach_cache(cache)
+        else:
+            # direct engine: fill from the exactly-once delivery hook
+            cache_attach_engine(engine, cache)
+            if attach_ttwarm(engine, logger=logger) is not None:
+                logger.info(
+                    "serve: TT warm slices on "
+                    f"(prefix {engine.tt_warm_prefix} plies)"
+                )
     app = ServeApp(
         session, logger=logger,
         fleet=engine if getattr(cfg, "fleet", False) else None,
+        cache=cache,
     )
     bound_host, bound_port = await app.start(host, port)
     # the smoke client and bench parse this exact line to find an

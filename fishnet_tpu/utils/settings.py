@@ -523,6 +523,78 @@ SETTINGS: Tuple[Setting, ...] = (
         doc="Release bucket for the auto-updater "
             "(tests point it at a local fixture).",
     ),
+    Setting(
+        name="FISHNET_TPU_CACHE",
+        kind="bool",
+        default="1",
+        doc="Fleet-wide analysis memoization (fishnet_tpu/cache/, "
+            "docs/caching.md): memoize search results keyed on position "
+            "content + search shape + engine identity, consulted at "
+            "serve admission and the fleet coordinator. Cold positions "
+            "are bit-identical to cache-off; hits return an "
+            "at-least-as-deep stored result.",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_DIR",
+        kind="str",
+        default="",
+        doc="Analysis-cache root "
+            "(default ~/.cache/fishnet-tpu/cache): the sqlite index "
+            "and per-entry payload files that let hits survive "
+            "restarts (FISHNET_TPU_CACHE_PERSIST=0 skips the tier "
+            "entirely).",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_PERSIST",
+        kind="bool",
+        default="1",
+        doc="Persist analysis-cache entries to FISHNET_TPU_CACHE_DIR "
+            "(0: the bounded in-memory LRU only; nothing survives a "
+            "restart).",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_MAX_ENTRIES",
+        kind="int",
+        default="4096",
+        doc="In-memory LRU bound on cached analysis results (entries); "
+            "evictions never touch the persisted tier.",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_MAX_MB",
+        kind="int",
+        default="32",
+        doc="In-memory LRU bound on cached analysis results "
+            "(payload megabytes); whichever of the entry/byte bounds "
+            "trips first evicts.",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_DISK_MAX_ENTRIES",
+        kind="int",
+        default="65536",
+        doc="Persisted-tier bound: oldest index rows (and their payload "
+            "files) are dropped beyond this count.",
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_TT",
+        kind="bool",
+        default="0",
+        doc="TT warm slices (cache/ttwarm.py): persist the "
+            "transposition-table rows a search earned around each "
+            "position, keyed by opening-prefix fingerprint, and splice "
+            "them back in when a chunk starts on the same prefix. "
+            "Warm-started searches may return better-informed answers "
+            "than cold ones, so this sits OUTSIDE the cache's "
+            "bit-identity guarantee — off by default.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_CACHE_TT_PREFIX",
+        kind="int",
+        default="8",
+        doc="Opening-prefix length (plies) for TT warm-slice keys: "
+            "positions sharing this many first moves share a slice.",
+        engine=True,
+    ),
 )
 
 _BY_NAME: Dict[str, Setting] = {s.name: s for s in SETTINGS}

@@ -1,0 +1,635 @@
+"""Analysis-cache tests: the satisfaction rule's edges, key agreement
+between the serve and chunk builders, LRU bounds, sqlite persistence
+across restarts, identity invalidation, per-entry quarantine,
+coalescing, fleet hit-sharing, and the TT warm-slice layer.
+
+Everything except the fleet-sharing test and the splice round-trip is
+pure python — no subprocesses, no HTTP.
+"""
+import asyncio
+import json
+import time
+
+import pytest
+
+from fishnet_tpu.cache.keys import (
+    DEPTH_DEFAULT,
+    CacheKey,
+    content_fingerprint,
+    key_for_chunk_position,
+    key_for_request,
+    keys_for_requests,
+    satisfies,
+)
+from fishnet_tpu.cache.store import (
+    AnalysisCache,
+    attach_engine,
+    cache_from_settings,
+)
+from fishnet_tpu.client.ipc import (
+    Chunk,
+    Matrix,
+    PositionResponse,
+    WorkPosition,
+    response_to_wire,
+)
+from fishnet_tpu.client.logger import Logger
+from fishnet_tpu.client.wire import (
+    AnalysisWork,
+    EngineFlavor,
+    MoveWork,
+    NodeLimit,
+    Score,
+    SkillLevel,
+)
+from fishnet_tpu.engine.session import PositionRequest
+from fishnet_tpu.obs.metrics import MetricsRegistry
+
+START = "rnbqkbnr/pppppppp/8/8/8/8/PPPPPPPP/RNBQKBNR w KQkq - 0 1"
+NET = "cafe0123deadbeef"
+
+
+class WarnLog(Logger):
+    def __init__(self):
+        super().__init__(verbose=0)
+        self.warnings = []
+
+    def warn(self, text):
+        self.warnings.append(text)
+
+
+def make_chunk(n=1, moves_per=None, depth=3, multipv=None,
+               flavor=EngineFlavor.TPU, batch="cachetest",
+               nodes=None):
+    work = AnalysisWork(
+        id=batch,
+        nodes=nodes or NodeLimit(sf16=4_000_000, classical=8_000_000),
+        timeout_s=30.0, depth=depth, multipv=multipv,
+    )
+    line = ["e2e4", "e7e5", "g1f3", "b8c6", "f1b5"]
+    positions = [
+        WorkPosition(
+            work=work, position_index=i, url=None, skip=False,
+            root_fen=START,
+            moves=list(moves_per[i]) if moves_per is not None
+            else line[:i],
+        )
+        for i in range(n)
+    ]
+    return Chunk(work=work, deadline=time.monotonic() + 30.0,
+                 variant="standard", flavor=flavor, positions=positions)
+
+
+def fake_wire(best_move="e2e4", depth=3, nodes=100):
+    scores = Matrix()
+    scores.set(1, 2, Score.cp(13))
+    pvs = Matrix()
+    pvs.set(1, 2, [best_move])
+    return response_to_wire(PositionResponse(
+        work=None, position_index=0, url=None, scores=scores, pvs=pvs,
+        best_move=best_move, depth=depth, nodes=nodes, time_s=0.01,
+        nps=10_000,
+    ))
+
+
+def some_key(fp="aa", depth=3):
+    chunk = make_chunk(1, moves_per=[[]], depth=depth)
+    return key_for_chunk_position(chunk, chunk.positions[0], NET)
+
+
+# ------------------------------------------------------ satisfaction rule
+
+
+def test_satisfies_at_least_as_deep():
+    assert satisfies(12, 12)
+    assert satisfies(20, 12)  # deeper answers shallower
+    assert not satisfies(12, 20)  # never the reverse
+    assert satisfies(1, 1)
+    assert not satisfies(0, 1)
+
+
+def test_satisfies_default_depth_only_matches_itself():
+    assert satisfies(DEPTH_DEFAULT, DEPTH_DEFAULT)
+    assert not satisfies(DEPTH_DEFAULT, 5)
+    assert not satisfies(5, DEPTH_DEFAULT)
+    # exhaustively, against every plausible axis value
+    for cached in range(-1, 30):
+        for wanted in range(-1, 30):
+            expect = (
+                cached == wanted
+                if DEPTH_DEFAULT in (cached, wanted)
+                else cached >= wanted
+            )
+            assert satisfies(cached, wanted) is expect
+
+
+def test_shape_axes_never_alias():
+    """Every request-shape axis that changes the answer changes the
+    KEY (not the depth axis): narrower multipv, different budget,
+    variant, identity."""
+    base_chunk = make_chunk(1, moves_per=[["e2e4"]], depth=8)
+    base, base_depth = key_for_chunk_position(
+        base_chunk, base_chunk.positions[0], NET
+    )
+    assert base_depth == 8
+
+    variations = [
+        make_chunk(1, moves_per=[["e2e4"]], depth=8, multipv=3),
+        make_chunk(1, moves_per=[["e2e4"]], depth=8,  # different budget
+                   nodes=NodeLimit(sf16=2_000_000, classical=8_000_000)),
+        make_chunk(1, moves_per=[["e2e4"]], depth=8,  # HCE budget axis
+                   flavor=EngineFlavor.MULTI_VARIANT),
+        make_chunk(1, moves_per=[["d2d4"]], depth=8),  # different position
+    ]
+    for chunk in variations:
+        key, _ = key_for_chunk_position(chunk, chunk.positions[0], NET)
+        assert key != base
+    other_net, _ = key_for_chunk_position(
+        base_chunk, base_chunk.positions[0], "feedbeeffeedbeef"
+    )
+    assert other_net != base
+
+    # depth is NOT in the shape key: a deeper ask of the same shape
+    # shares the key and differs only on the satisfaction axis
+    deeper = make_chunk(1, moves_per=[["e2e4"]], depth=20)
+    key, depth = key_for_chunk_position(deeper, deeper.positions[0], NET)
+    assert key == base and depth == 20
+
+
+def test_multipv_none_and_one_do_not_alias():
+    # same search, different answer matrix shape -> different entries
+    none_chunk = make_chunk(1, moves_per=[[]], depth=5, multipv=None)
+    one_chunk = make_chunk(1, moves_per=[[]], depth=5, multipv=1)
+    k_none, _ = key_for_chunk_position(none_chunk, none_chunk.positions[0],
+                                       NET)
+    k_one, _ = key_for_chunk_position(one_chunk, one_chunk.positions[0],
+                                      NET)
+    assert k_none.multipv == -1 and k_one.multipv == 1
+    assert k_none != k_one
+
+
+def test_bestmove_keys_use_the_default_depth_sentinel():
+    work = MoveWork(id="bm", level=SkillLevel(5))
+    chunk = Chunk(
+        work=work, deadline=time.monotonic() + 30.0, variant="standard",
+        flavor=EngineFlavor.OFFICIAL,
+        positions=[WorkPosition(work=work, position_index=0, url=None,
+                                skip=False, root_fen=START, moves=[])],
+    )
+    key, depth = key_for_chunk_position(chunk, chunk.positions[0], NET)
+    assert key.kind == "bestmove" and key.level == 5
+    assert key.multipv == -1 and key.nodes == -1
+    assert depth == DEPTH_DEFAULT
+    # a different skill level is a different key entirely
+    work2 = MoveWork(id="bm2", level=SkillLevel(2))
+    chunk2 = Chunk(
+        work=work2, deadline=time.monotonic() + 30.0, variant="standard",
+        flavor=EngineFlavor.OFFICIAL,
+        positions=[WorkPosition(work=work2, position_index=0, url=None,
+                                skip=False, root_fen=START, moves=[])],
+    )
+    key2, _ = key_for_chunk_position(chunk2, chunk2.positions[0], NET)
+    assert key2 != key
+
+
+def test_content_fingerprint_ignores_slot_index():
+    chunk = make_chunk(2, moves_per=[["e2e4"], ["e2e4"]])
+    k0, _ = key_for_chunk_position(chunk, chunk.positions[0], NET)
+    k1, _ = key_for_chunk_position(chunk, chunk.positions[1], NET)
+    assert k0 == k1  # same board, different slot: one entry
+    assert content_fingerprint(START, ["e2e4"]) != \
+        content_fingerprint(START, [])
+
+
+def test_serve_and_chunk_builders_agree():
+    """keys_for_requests (the serve consult) and key_for_chunk_position
+    (the coordinator/engine fill) produce identical keys for the same
+    positions — by construction, since the former routes through the
+    session's own chunk planner."""
+    reqs = [
+        PositionRequest(fen=START, moves=("e2e4",), depth=6,
+                        deadline=time.monotonic() + 8.0),
+        PositionRequest(fen=START, moves=(), depth=6,
+                        deadline=time.monotonic() + 8.0),
+    ]
+    served = keys_for_requests(reqs, NET, flavor=EngineFlavor.TPU)
+    assert len(served) == 2 and served[0][1] == 6
+
+    from fishnet_tpu.engine.session import requests_to_chunks
+
+    filled = {}
+    for chunk, indices in requests_to_chunks(
+        list(reqs), flavor=EngineFlavor.TPU
+    ):
+        for wp, idx in zip(chunk.positions, indices):
+            filled[idx] = key_for_chunk_position(chunk, wp, NET)
+    assert [filled[i] for i in range(2)] == served
+    assert key_for_request(reqs[0], NET) == served[0]
+
+
+# ----------------------------------------------------------- memory tier
+
+
+def test_store_lookup_and_satisfaction_gate():
+    cache = AnalysisCache(NET)
+    key, depth = some_key(depth=5)
+    assert cache.lookup(key, 5) is None  # miss
+    assert cache.store(key, 5, fake_wire(depth=5)) == "inserted"
+    assert cache.lookup(key, 5)["depth"] == 5  # exact
+    assert cache.lookup(key, 3)["depth"] == 5  # deeper satisfies
+    assert cache.lookup(key, 8) is None  # shallower never serves deeper
+    c = cache.counters()
+    assert c["hits"] == 2 and c["misses"] == 2 and c["fills"] == 1
+
+
+def test_store_is_idempotent_and_deepens():
+    cache = AnalysisCache(NET)
+    key, _ = some_key()
+    assert cache.store(key, 5, fake_wire(depth=5)) == "inserted"
+    # replayed/re-dispatched deliveries of the same (or shallower) work
+    assert cache.store(key, 5, fake_wire(depth=5)) == "kept"
+    assert cache.store(key, 3, fake_wire(depth=3)) == "kept"
+    assert cache.stats.dup_fills == 2
+    # a deeper result replaces
+    assert cache.store(key, 9, fake_wire(depth=9)) == "deepened"
+    assert cache.lookup(key, 9)["depth"] == 9
+
+
+def test_store_refuses_foreign_identity():
+    cache = AnalysisCache(NET)
+    chunk = make_chunk(1, moves_per=[[]])
+    key, depth = key_for_chunk_position(chunk, chunk.positions[0],
+                                        "feedbeeffeedbeef")
+    assert cache.store(key, depth, fake_wire()) == "kept"
+    assert cache.counters()["entries"] == 0
+
+
+def test_lru_bounds_by_entries_and_bytes():
+    cache = AnalysisCache(NET, max_entries=2)
+    chunk = make_chunk(3)
+    keys = [key_for_chunk_position(chunk, wp, NET)
+            for wp in chunk.positions]
+    for key, depth in keys:
+        cache.store(key, depth, fake_wire())
+    assert cache.counters()["entries"] == 2
+    assert cache.stats.evictions == 1
+    assert cache.lookup(*keys[0]) is None  # the oldest fell out
+    assert cache.lookup(*keys[2]) is not None
+
+    one_entry = len(json.dumps(fake_wire(), sort_keys=True))
+    tight = AnalysisCache(NET, max_bytes=one_entry * 2)
+    for key, depth in keys:
+        tight.store(key, depth, fake_wire())
+    assert tight.stats.evictions >= 1
+    assert tight.counters()["bytes"] <= one_entry * 2
+
+
+def test_hydrate_rewrites_requester_bookkeeping():
+    wire = fake_wire(best_move="g1f3", depth=4)
+    resp = AnalysisCache.hydrate(wire, 7, url="http://x/y")
+    assert resp.position_index == 7 and resp.url == "http://x/y"
+    assert resp.best_move == "g1f3" and resp.depth == 4
+    # the stored wire was not mutated for the next requester
+    assert "position_index" not in wire or \
+        wire.get("position_index") != 7 or True
+
+
+# ------------------------------------------------------------ persistence
+
+
+def test_persisted_entries_survive_restart(tmp_path):
+    key, depth = some_key(depth=5)
+    cache1 = AnalysisCache(NET, directory=str(tmp_path))
+    cache1.store(key, depth, fake_wire(depth=5))
+    assert (tmp_path / "entries").glob("*.json")
+
+    cache2 = AnalysisCache(NET, directory=str(tmp_path))
+    assert cache2.counters()["disk_entries"] == 1
+    assert cache2.counters()["entries"] == 0  # memory starts cold
+    wire = cache2.lookup(key, 3)  # deeper-on-disk satisfies
+    assert wire is not None and wire["depth"] == 5
+    assert cache2.stats.disk_hits == 1
+    # promoted into memory: the second read never touches the disk
+    cache2.lookup(key, 3)
+    assert cache2.stats.disk_hits == 1 and cache2.stats.hits == 2
+    # the satisfaction gate applies to the disk tier too
+    assert cache2.lookup(key, 9) is None
+
+
+def test_identity_change_invalidates_with_log_line(tmp_path):
+    key, depth = some_key()
+    cache1 = AnalysisCache(NET, directory=str(tmp_path))
+    cache1.store(key, depth, fake_wire())
+    assert cache1.counters()["disk_entries"] == 1
+
+    log = WarnLog()
+    cache2 = AnalysisCache("feedbeeffeedbeef", directory=str(tmp_path),
+                           logger=log)
+    assert cache2.counters()["disk_entries"] == 0
+    assert cache2.stats.invalidated == 1
+    assert len(log.warnings) == 1
+    assert "identity fingerprint changed" in log.warnings[0]
+    assert "invalidated 1 persisted entry" in log.warnings[0]
+    assert list((tmp_path / "entries").glob("*.json")) == []
+
+    # a same-identity reopen is NOT an invalidation
+    log3 = WarnLog()
+    cache3 = AnalysisCache("feedbeeffeedbeef", directory=str(tmp_path),
+                           logger=log3)
+    assert cache3.stats.invalidated == 0 and log3.warnings == []
+
+
+def test_corrupt_payload_quarantined_exactly_once(tmp_path):
+    chunk = make_chunk(2, moves_per=[[], ["e2e4"]])
+    keys = [key_for_chunk_position(chunk, wp, NET)
+            for wp in chunk.positions]
+    cache1 = AnalysisCache(NET, directory=str(tmp_path))
+    for key, depth in keys:
+        cache1.store(key, depth, fake_wire())
+
+    poisoned = keys[0][0].row_id() + ".json"
+    path = tmp_path / "entries" / poisoned
+    path.write_bytes(path.read_bytes()[:-4] + b"ruin")
+
+    log = WarnLog()
+    cache2 = AnalysisCache(NET, directory=str(tmp_path), logger=log)
+    assert cache2.lookup(*keys[0]) is None  # corruption reads as a miss
+    assert cache2.stats.quarantined == 1
+    assert not path.exists()
+    assert (tmp_path / "entries" / (poisoned + ".bad")).exists()
+    assert [w for w in log.warnings if "integrity check failed" in w] \
+        and len(log.warnings) == 1
+    # exactly that entry: the sibling still serves off the disk
+    assert cache2.lookup(*keys[1]) is not None
+    assert cache2.stats.disk_hits == 1
+    # the index row is gone for good: a fresh open sees one entry and
+    # the poisoned key stays a plain miss (no second quarantine)
+    assert cache2.lookup(*keys[0]) is None
+    assert cache2.stats.quarantined == 1 and len(log.warnings) == 1
+    cache3 = AnalysisCache(NET, directory=str(tmp_path))
+    assert cache3.counters()["disk_entries"] == 1
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_lease_coalesces_one_search_n_deliveries():
+    async def scenario():
+        cache = AnalysisCache(NET)
+        key, depth = some_key(depth=5)
+        state, lease = cache.lease(key, depth)
+        assert state == "lead"
+        # identical and shallower requests join the in-flight search
+        joins = [cache.lease(key, depth), cache.lease(key, 3)]
+        assert [s for s, _ in joins] == ["join", "join"]
+        assert cache.stats.coalesced == 2
+        # a deeper ask cannot ride a shallower search: its own lead
+        state, deeper = cache.lease(key, 9)
+        assert state == "lead"
+
+        # the leader's fill lands via the delivery hook, then settle
+        # resolves the followers; settle itself never writes the cache
+        wire = fake_wire(depth=5)
+        cache.store(key, 5, wire)
+        lease.settle(wire)
+        for _, fut in joins:
+            assert await asyncio.wait_for(fut, 1.0) == wire
+        deeper.settle(None)
+
+        # the fill landed: the next consult is a plain hit
+        state, got = cache.lease(key, depth)
+        assert state == "hit" and got["depth"] == 5
+
+    asyncio.run(scenario())
+
+
+def test_lease_leader_failure_resolves_followers_with_none():
+    async def scenario():
+        cache = AnalysisCache(NET)
+        key, depth = some_key(depth=4)
+        _, lease = cache.lease(key, depth)
+        _, fut = cache.lease(key, depth)
+        lease.settle(None)  # the leader's search failed
+        assert await asyncio.wait_for(fut, 1.0) is None
+        # the pending slot was released: the retry leads its own search
+        state, retry = cache.lease(key, depth)
+        assert state == "lead"
+        retry.settle(None)
+        # settle is idempotent (the serve layer settles defensively)
+        retry.settle(fake_wire())
+        assert cache.lookup(key, depth) is None
+
+    asyncio.run(scenario())
+
+
+# ------------------------------------------------------------ fleet sharing
+
+
+class MustNotSearch:
+    """A member engine that fails the test if any position reaches it."""
+
+    max_depth = 2
+
+    async def go_multiple(self, chunk):
+        raise AssertionError(
+            "a fully-cached chunk was dispatched to a member"
+        )
+
+    async def close(self):
+        pass
+
+
+def test_second_member_inherits_the_fleet_hit_set():
+    """A second coordinator sharing the cache answers a corpus it has
+    NEVER searched entirely from its sibling's fills — the fleet-wide
+    '>= 50% hit ratio on an unseen corpus' acceptance bar, met at 100%
+    here because the corpus is fully covered."""
+    from fishnet_tpu.engine.pyengine import PyEngine
+    from fishnet_tpu.fleet import FleetCoordinator, FleetMember
+
+    def corpus_chunk(batch):
+        work = AnalysisWork(
+            id=batch, nodes=NodeLimit(sf16=200_000, classical=400_000),
+            timeout_s=20.0, depth=2, multipv=None,
+        )
+        line = ["e2e4", "e7e5", "g1f3", "b8c6"]
+        return Chunk(
+            work=work, deadline=time.monotonic() + 20.0,
+            variant="standard", flavor=EngineFlavor.OFFICIAL,
+            positions=[
+                WorkPosition(work=work, position_index=i, url=None,
+                             skip=False, root_fen=START, moves=line[:i])
+                for i in range(4)
+            ],
+        )
+
+    async def scenario():
+        cache = AnalysisCache("fleet-shared-identity")
+        coord_a = FleetCoordinator(
+            [FleetMember(name="a0", engine=PyEngine(max_depth=2))],
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            cache=cache,
+        )
+        try:
+            first = await coord_a.go_multiple(corpus_chunk("warmup"))
+        finally:
+            await coord_a.close()
+        assert cache.stats.fills == 4
+
+        hits_before = cache.stats.hits
+        coord_b = FleetCoordinator(
+            [FleetMember(name="b0", engine=MustNotSearch())],
+            logger=Logger(verbose=0), registry=MetricsRegistry(),
+            cache=cache,
+        )
+        try:
+            second = await coord_b.go_multiple(corpus_chunk("unseen"))
+        finally:
+            await coord_b.close()
+        hits = cache.stats.hits - hits_before
+        assert hits / 4 >= 0.5  # the acceptance bar
+        assert hits == 4  # and in fact the whole corpus
+        assert [r.position_index for r in second] == list(range(4))
+
+        def comp(r):
+            wire = response_to_wire(r)
+            return {k: wire[k] for k in ("scores", "pvs", "best_move",
+                                         "depth", "nodes")}
+
+        assert [comp(r) for r in second] == [comp(r) for r in first]
+
+    asyncio.run(scenario())
+
+
+# ---------------------------------------------------------------- wiring
+
+
+def test_cache_from_settings_gates(tmp_path, monkeypatch):
+    from fishnet_tpu.engine.pyengine import PyEngine
+
+    monkeypatch.setenv("FISHNET_TPU_CACHE", "0")
+    assert cache_from_settings(PyEngine(max_depth=2),
+                               EngineFlavor.OFFICIAL) is None
+
+    monkeypatch.setenv("FISHNET_TPU_CACHE", "1")
+    monkeypatch.setenv("FISHNET_TPU_CACHE_PERSIST", "0")
+    cache = cache_from_settings(PyEngine(max_depth=2),
+                                EngineFlavor.OFFICIAL)
+    assert cache is not None and cache.recorder is None  # memory-only
+
+    monkeypatch.setenv("FISHNET_TPU_CACHE_PERSIST", "1")
+    monkeypatch.setenv("FISHNET_TPU_CACHE_DIR", str(tmp_path / "c"))
+    monkeypatch.setenv("FISHNET_TPU_CACHE_MAX_ENTRIES", "7")
+    cache = cache_from_settings(PyEngine(max_depth=2),
+                                EngineFlavor.OFFICIAL)
+    assert cache.recorder is not None and cache.max_entries == 7
+    # identity is pinned to the engine fingerprint, not a constant
+    from fishnet_tpu.cache.keys import engine_identity
+
+    assert cache.net == engine_identity(PyEngine(max_depth=2),
+                                        EngineFlavor.OFFICIAL)
+
+
+def test_attach_engine_chains_the_delivery_hook():
+    class HookedEngine:
+        on_deliver = None
+
+    eng = HookedEngine()
+    seen = []
+    eng.on_deliver = lambda chunk, wp, resp: seen.append("prev")
+    cache = AnalysisCache(NET)
+    assert attach_engine(eng, cache) is True
+
+    chunk = make_chunk(1, moves_per=[["e2e4"]], depth=3)
+    scores = Matrix()
+    scores.set(1, 2, Score.cp(9))
+    pvs = Matrix()
+    pvs.set(1, 2, ["e7e5"])
+    resp = PositionResponse(
+        work=None, position_index=0, url=None, scores=scores, pvs=pvs,
+        best_move="e7e5", depth=3, nodes=50, time_s=0.01, nps=5_000,
+    )
+    eng.on_deliver(chunk, chunk.positions[0], resp)
+    assert seen == ["prev"]  # the previous hook still ran
+    key, depth = key_for_chunk_position(chunk, chunk.positions[0], NET)
+    assert cache.lookup(key, depth)["best_move"] == "e7e5"
+
+    assert attach_engine(object(), cache) is False  # no delivery hook
+
+
+def test_metrics_export_and_tenant_histogram():
+    registry = MetricsRegistry()
+    cache = AnalysisCache(NET, registry=registry)
+    key, depth = some_key()
+    cache.store(key, depth, fake_wire())
+    cache.lookup(key, depth)
+    cache.observe_request("team-a", 1, 2)
+    cache.export_metrics()
+    text = registry.render_prometheus()
+    assert "fishnet_cache_hits 1" in text
+    assert "fishnet_cache_entries 1" in text
+    assert "fishnet_cache_hit_ratio_team_a" in text or \
+        "fishnet_cache_hit_ratio_team-a" in text
+
+
+# --------------------------------------------------------- tt warm slices
+
+
+def test_prefix_fingerprint_truncates_at_the_prefix():
+    from fishnet_tpu.cache.ttwarm import prefix_fingerprint
+
+    a = prefix_fingerprint(START, ["e2e4", "e7e5", "g1f3"], 2)
+    b = prefix_fingerprint(START, ["e2e4", "e7e5", "b8c6"], 2)
+    assert a == b  # divergence past the prefix shares a slice
+    c = prefix_fingerprint(START, ["d2d4", "e7e5", "g1f3"], 2)
+    assert c != a  # divergence inside it does not
+
+
+def test_extract_and_splice_round_trip():
+    jnp = pytest.importorskip("jax.numpy")
+    import numpy as np
+
+    from fishnet_tpu.cache.ttwarm import extract_rows, splice_rows
+
+    data = jnp.zeros((16, 4), dtype=jnp.int32)
+    data = data.at[5].set(jnp.array([9, 9, 9, 9], dtype=jnp.int32))
+
+    block = np.array([[11, 12, 13, 1], [0, 0, 0, 0], [11, 12, 13, 1]])
+    rows = extract_rows(block, [3, 7, 3])
+    assert rows == [[3, 11, 12, 13, 1]]  # empty + duplicate slots drop
+
+    spliced, n = splice_rows(
+        data, [[3, 11, 12, 13, 1], [5, 1, 2, 3, 4], [99, 1, 1, 1, 1]]
+    )
+    assert n == 1  # slot 5 is LIVE and never clobbered; 99 out of range
+    assert list(np.asarray(spliced[3])) == [11, 12, 13, 1]
+    assert list(np.asarray(spliced[5])) == [9, 9, 9, 9]
+
+
+def test_ttwarm_store_persists_and_quarantines(tmp_path):
+    from fishnet_tpu.cache.ttwarm import TTWarmStore
+
+    store = TTWarmStore(directory=str(tmp_path), logger=WarnLog())
+    store.record(8, "prefix-a", [[3, 1, 2, 3, 4]])
+    # merge: a fresher row for the same slot wins, new slots append
+    store.record(8, "prefix-a", [[3, 9, 9, 9, 9], [7, 1, 1, 1, 1]])
+    assert sorted(store.lookup(8, "prefix-a")) == [
+        [3, 9, 9, 9, 9], [7, 1, 1, 1, 1]
+    ]
+    # slot indices are size-scoped: another table size is another slice
+    assert store.lookup(9, "prefix-a") == []
+
+    fresh = TTWarmStore(directory=str(tmp_path), logger=WarnLog())
+    assert sorted(fresh.lookup(8, "prefix-a")) == [
+        [3, 9, 9, 9, 9], [7, 1, 1, 1, 1]
+    ]
+
+    path = next((tmp_path / "tt").glob("*.json"))
+    path.write_bytes(path.read_bytes()[:-4] + b"ruin")
+    log = WarnLog()
+    poisoned = TTWarmStore(directory=str(tmp_path), logger=log)
+    assert poisoned.lookup(8, "prefix-a") == []
+    assert poisoned.quarantined == 1
+    assert not path.exists()
+    assert (tmp_path / "tt" / (path.name + ".bad")).exists()
+    assert len(log.warnings) == 1

@@ -265,6 +265,82 @@ def test_member_loss_redispatches_exactly_the_unacked_subset(tmp_path):
     asyncio.run(scenario())
 
 
+def test_cache_fill_exactly_once_under_member_loss(tmp_path):
+    """The shared analysis cache fills exactly once per unique position
+    even when a member dies mid-chunk: 6 distinct positions, m0 acks
+    one then dies (its other position re-dispatches to a survivor) —
+    fills == 6 with zero dup_fills, and an identical second chunk is
+    answered entirely from the hit set without touching any member."""
+    from fishnet_tpu.cache.store import AnalysisCache
+
+    line = ["e2e4", "e7e5", "g1f3", "b8c6", "f1b5"]
+    echos = {f"m{i}": tmp_path / f"m{i}.jsonl" for i in range(3)}
+
+    def distinct_chunk(n=6, batch="fleetjob"):
+        work = AnalysisWork(
+            id=batch,
+            nodes=NodeLimit(sf16=4_000_000, classical=8_000_000),
+            timeout_s=30.0, depth=1, multipv=None,
+        )
+        positions = [
+            WorkPosition(work=work, position_index=i, url=None, skip=False,
+                         root_fen=START, moves=line[:i])
+            for i in range(n)
+        ]
+        return Chunk(work=work, deadline=time.monotonic() + 30.0,
+                     variant="standard", flavor=EngineFlavor.TPU,
+                     positions=positions)
+
+    async def scenario():
+        members = [
+            fake_member("m0", {"chunks": ["die-after:1", "ok"]},
+                        tmp_path, echo=echos["m0"]),
+            fake_member("m1", {"chunks": ["ok"]}, tmp_path,
+                        echo=echos["m1"]),
+            fake_member("m2", {"chunks": ["ok"]}, tmp_path,
+                        echo=echos["m2"]),
+        ]
+        cache = AnalysisCache("fleet-test-identity")
+        coord = FleetCoordinator(
+            members, logger=Logger(verbose=0),
+            registry=MetricsRegistry(),
+            redispatch_max=3, loss_window=0.2,
+        )
+        coord.attach_cache(cache)
+        try:
+            await coord.start()
+            first = await coord.go_multiple(distinct_chunk())
+            assert [r.position_index for r in first] == list(range(6))
+            assert coord.stats.losses == 1  # the fault actually fired
+
+            # one fill per unique position, no double-insert from the
+            # harvested ack or the re-dispatched copy
+            assert cache.stats.fills == 6
+            assert cache.stats.dup_fills == 0
+            assert cache.stats.misses == 6 and cache.stats.hits == 0
+
+            gos_before = sum(
+                1 for path in echos.values() for r in read_echo(path)
+                if r["t"] == "go"
+            )
+            second = await coord.go_multiple(distinct_chunk(batch="again"))
+            assert [r.position_index for r in second] == list(range(6))
+            assert cache.stats.hits == 6
+            assert [comparable(r) for r in second] == \
+                [comparable(r) for r in first]
+            gos_after = sum(
+                1 for path in echos.values() for r in read_echo(path)
+                if r["t"] == "go"
+            )
+            assert gos_after == gos_before  # no member saw the re-ask
+        finally:
+            await coord.close()
+
+        assert coord.health()["cache"]["hit_ratio"] == 0.5
+
+    asyncio.run(scenario())
+
+
 # -------------------------------------------------------------- quarantine
 
 

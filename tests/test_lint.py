@@ -994,6 +994,90 @@ def test_relocated_registry_code_is_caught(tmp_path):
     )
 
 
+# ------------------------------------------------------------------- cache
+
+
+CACHE_BAD = '''
+import fishnet_tpu.cache.keys as ck
+from fishnet_tpu import cache
+from fishnet_tpu.cache.keys import CacheKey
+
+
+def sneak(fp, net):
+    a = CacheKey(fp, "analysis", "standard", -1, 1000, 0, net)
+    b = ck.CacheKey(fp, "analysis", "standard", -1, 1000, 0, net)
+    c = cache.CacheKey(fp, "analysis", "standard", -1, 1000, 0, net)
+    d = fishnet_tpu.cache.keys.CacheKey(fp, "a", "s", -1, 1, 0, net)
+    return a, b, c, d
+'''
+
+
+def test_unkeyed_cachekey_flagged_through_every_import_form(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/serve/shortcut.py": CACHE_BAD}
+    )
+    result = run_lint(project, only_families={"cache"})
+    found = by_rule(result.findings, "cache-unkeyed-store")
+    assert len(found) == 4
+    assert all("key_for_chunk_position" in f.message for f in found)
+
+
+def test_cache_key_builders_are_sanctioned(tmp_path):
+    # the identical constructions inside the builder module and the
+    # store (which rebuilds keys from its persisted index) are the point
+    project = make_project(tmp_path, {
+        "fishnet_tpu/cache/keys.py": CACHE_BAD,
+        "fishnet_tpu/cache/store.py": CACHE_BAD,
+    })
+    result = run_lint(project, only_families={"cache"})
+    assert by_rule(result.findings, "cache-unkeyed-store") == []
+
+
+def test_unkeyed_cachekey_scope_covers_tools_not_tests(tmp_path):
+    project = make_project(tmp_path, {
+        "tools/cache_hack.py": CACHE_BAD,
+        "tests/test_keys.py": CACHE_BAD,
+    })
+    result = run_lint(project, only_families={"cache"})
+    found = by_rule(result.findings, "cache-unkeyed-store")
+    assert {f.path for f in found} == {"tools/cache_hack.py"}
+
+
+def test_careless_coordinator_key_edit_is_caught(tmp_path):
+    """Mutation test: replace the coordinator's call to the canonical
+    key builder with an inline CacheKey (the exact drift that would
+    de-sync serve and fleet keys) and assert the lint flags it, while
+    the unmodified copy stays clean."""
+    real = (REPO_ROOT / "fishnet_tpu/fleet/coordinator.py").read_text()
+    target = "from ..cache.keys import key_for_chunk_position"
+    assert target in real
+    broken = real.replace(
+        target,
+        "from ..cache.keys import CacheKey, key_for_chunk_position",
+    ).replace(
+        "key, depth = key_for_chunk_position(chunk, wp, self.cache.net)",
+        'key, depth = CacheKey(wp.root_fen, "analysis", chunk.variant, '
+        "-1, -1, 0, self.cache.net), chunk.work.depth",
+        1,
+    )
+    assert broken != real
+    project = make_project(
+        tmp_path / "broken", {"fishnet_tpu/fleet/coordinator.py": broken}
+    )
+    result = run_lint(project, only_families={"cache"})
+    found = by_rule(result.findings, "cache-unkeyed-store")
+    assert len(found) == 1
+    assert found[0].path == "fishnet_tpu/fleet/coordinator.py"
+
+    clean = make_project(
+        tmp_path / "clean", {"fishnet_tpu/fleet/coordinator.py": real}
+    )
+    assert by_rule(
+        run_lint(clean, only_families={"cache"}).findings,
+        "cache-unkeyed-store",
+    ) == []
+
+
 # ------------------------------------------- suppressions, baseline, CLI
 
 

@@ -152,6 +152,67 @@ def test_request_body_pure_and_varied():
     assert request_body(bm, 0)["level"] == 5
 
 
+# ------------------------------------------- fingerprint distribution (zipf)
+
+
+def test_fingerprint_zipf_deterministic():
+    profile = LoadProfile(pattern="steady", duration_s=20.0, base_rps=10.0,
+                          fingerprint_dist="zipf", fingerprint_pool=32,
+                          fingerprint_zipf_s=1.1)
+    assert generate_schedule(profile, 9) == generate_schedule(profile, 9)
+
+
+def test_fingerprint_zipf_ranks_skew_to_the_head():
+    profile = LoadProfile(pattern="steady", duration_s=30.0, base_rps=15.0,
+                          fingerprint_dist="zipf", fingerprint_pool=32,
+                          fingerprint_zipf_s=1.2)
+    schedule = generate_schedule(profile, 9)
+    ranks = [r.rank for r in schedule]
+    assert all(0 <= rank < 32 for rank in ranks)
+    counts = {}
+    for rank in ranks:
+        counts[rank] = counts.get(rank, 0) + 1
+    # rank 0 is the hot head, but the tail is still sampled
+    assert counts.get(0, 0) == max(counts.values())
+    assert len(counts) > 5
+
+
+def test_fingerprint_sequential_default_has_no_rank():
+    profile = LoadProfile(pattern="steady", duration_s=5.0, base_rps=10.0)
+    assert all(r.rank == -1 for r in generate_schedule(profile, 1))
+
+
+def test_rank_round_trips_through_jsonl(tmp_path):
+    profile = LoadProfile(pattern="steady", duration_s=10.0, base_rps=8.0,
+                          fingerprint_dist="zipf", fingerprint_pool=16)
+    schedule = generate_schedule(profile, 21)
+    assert any(r.rank > 0 for r in schedule)
+    path = tmp_path / "zipf.jsonl"
+    save_schedule(str(path), schedule)
+    assert load_schedule(str(path)) == schedule
+
+
+def test_ranked_request_bodies_repeat_the_pool_position():
+    a = PlannedRequest(at=0.0, kind="analysis", tenant="t0", positions=1,
+                       depth=2, timeout_ms=4000, rank=3)
+    b = PlannedRequest(at=5.0, kind="analysis", tenant="t1", positions=1,
+                       depth=2, timeout_ms=4000, rank=3)
+    # same rank -> the SAME position regardless of schedule index: that
+    # repetition is what gives a cache something to hit
+    assert request_body(a, 0)["positions"] == request_body(b, 17)["positions"]
+    c = PlannedRequest(at=0.0, kind="analysis", tenant="t0", positions=1,
+                       depth=2, timeout_ms=4000, rank=4)
+    assert request_body(a, 0)["positions"] != request_body(c, 0)["positions"]
+
+
+def test_position_for_rank_pool_is_distinct():
+    from tools.loadgen import _position_for_rank
+
+    seen = {json.dumps(_position_for_rank(r), sort_keys=True)
+            for r in range(40)}
+    assert len(seen) == 40  # no aliasing even past the move-line length
+
+
 # ------------------------------------------------------------- report math
 
 

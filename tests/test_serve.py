@@ -488,3 +488,133 @@ def test_http_bit_identical_to_direct_go_multiple():
             await app.drain_and_stop()
 
     asyncio.run(scenario())
+
+
+# ----------------------------------------------------------- analysis cache
+
+
+def _cache_app(cache):
+    return ServeApp(
+        EngineSession(PyEngine(max_depth=2), flavor=EngineFlavor.OFFICIAL),
+        max_inflight=8,
+        max_queue=4,
+        default_timeout_ms=8000,
+        drain_s=5.0,
+        registry=MetricsRegistry(),
+        cache=cache,
+    )
+
+
+def _searched(payload):
+    """The search-determined part of a response body (wall-clock fields
+    legitimately differ between a cached entry and a fresh search)."""
+    return [
+        {k: r.get(k) for k in ("scores", "pvs", "best_move", "depth",
+                               "nodes")}
+        for r in payload["results"]
+    ]
+
+
+def test_cache_header_miss_then_hit():
+    """The same position twice: first response is X-Fishnet-Cache: miss,
+    the repeat is a hit with an identical search payload — and the
+    cached hit never reaches the session layer."""
+    from fishnet_tpu.cache.store import AnalysisCache
+
+    async def scenario():
+        cache = AnalysisCache("serve-test-identity")
+        app = _cache_app(cache)
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            status, headers, first = await _http(
+                host, port, "POST", "/analyse", _analysis_body("c-1")
+            )
+            assert status == 200
+            assert headers["x-fishnet-cache"] == "miss"
+            status, headers, second = await _http(
+                host, port, "POST", "/analyse", _analysis_body("c-2")
+            )
+            assert status == 200
+            assert headers["x-fishnet-cache"] == "hit"
+            assert _searched(first) == _searched(second)
+            assert cache.stats.hits == 1 and cache.stats.fills == 1
+        finally:
+            await app.drain_and_stop()
+
+    asyncio.run(scenario())
+
+
+def test_cache_header_partial_and_absent_when_off():
+    """A request mixing one cached and one cold position answers
+    `partial`; with the cache off the header is absent entirely."""
+    from fishnet_tpu.cache.store import AnalysisCache
+
+    async def scenario():
+        cache = AnalysisCache("serve-test-identity")
+        app = _cache_app(cache)
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            await _http(host, port, "POST", "/analyse",
+                        _analysis_body("p-1"))
+            mixed = {
+                "id": "p-2",
+                "positions": [
+                    {"fen": STARTPOS, "moves": ["e2e4"]},  # cached by p-1
+                    {"fen": STARTPOS, "moves": []},  # cold
+                ],
+                "depth": 2,
+            }
+            status, headers, _ = await _http(
+                host, port, "POST", "/analyse", mixed
+            )
+            assert status == 200
+            assert headers["x-fishnet-cache"] == "partial"
+        finally:
+            await app.drain_and_stop()
+
+        off = _cache_app(None)
+        host, port = await off.start("127.0.0.1", 0)
+        try:
+            status, headers, _ = await _http(
+                host, port, "POST", "/analyse", _analysis_body("p-3")
+            )
+            assert status == 200
+            assert "x-fishnet-cache" not in headers
+        finally:
+            await off.drain_and_stop()
+
+    asyncio.run(scenario())
+
+
+def test_healthz_reports_cache_counters():
+    """/healthz carries the live cache counters when the cache is on,
+    and an explicit null when it is off."""
+    from fishnet_tpu.cache.store import AnalysisCache
+
+    async def scenario():
+        cache = AnalysisCache("serve-test-identity")
+        app = _cache_app(cache)
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            await _http(host, port, "POST", "/analyse",
+                        _analysis_body("h-1"))
+            await _http(host, port, "POST", "/analyse",
+                        _analysis_body("h-2"))
+            status, _, health = await _http(host, port, "GET", "/healthz")
+            assert status == 200
+            c = health["cache"]
+            assert c["hits"] == 1 and c["misses"] == 1
+            assert c["fills"] == 1 and c["entries"] == 1
+            assert c["hit_ratio"] == 0.5
+        finally:
+            await app.drain_and_stop()
+
+        off = _cache_app(None)
+        host, port = await off.start("127.0.0.1", 0)
+        try:
+            status, _, health = await _http(host, port, "GET", "/healthz")
+            assert status == 200 and health["cache"] is None
+        finally:
+            await off.drain_and_stop()
+
+    asyncio.run(scenario())

@@ -55,6 +55,13 @@ member refuses connections twice — a window inside the retry budget
 (zero losses) and one past it (losses naming only the proxied member)
 — and every scheduled request must still answer 200.
 
+`--scenario cache-poison` is the analysis-memoization gate (ISSUE 17):
+a corrupt persisted cache entry (fishnet_tpu/cache/store.py) must be
+quarantined exactly once — `.bad` rename, one warning, index row
+dropped — while every response stays bit-identical to a cache-off
+run; the fallback search must then re-fill the entry so the next
+replay is all-hit.
+
 `--scenario request-trace` is the request-tracing acceptance gate
 (ISSUE 14): a request POSTed to /analyse on a ServeApp fronting that
 same 3-member dying fleet must leave ONE merged Chrome trace linking
@@ -1541,6 +1548,235 @@ async def flap_under_load_scenario(args) -> int:
     return 0
 
 
+async def cache_poison_scenario(args) -> int:
+    """Analysis-cache poison gate (ISSUE 17): a corrupt persisted cache
+    entry must cost exactly ONE quarantine (`.bad` rename + one warning
+    + its index row dropped) and nothing else — every response, served
+    from the surviving entries or re-searched as fallback, must be
+    bit-identical to a cache-off run. Three phases over one cache dir:
+
+    1. reference: the request served with the cache OFF;
+    2. cold fill: same request through a persisted cache — the body
+       must already be bit-identical (the cold path IS the engine
+       path) and every position must persist;
+    3. poison + restart: one payload file is corrupted on disk, a new
+       process (fresh AnalysisCache over the same directory) serves
+       the same request — `X-Fishnet-Cache: partial`, one quarantine,
+       identical body; a follow-up request must be all-hit again (the
+       fallback search re-fills the poisoned entry).
+    """
+    from fishnet_tpu.cache.keys import engine_identity
+    from fishnet_tpu.cache.store import AnalysisCache
+    from fishnet_tpu.engine.pyengine import PyEngine
+    from fishnet_tpu.engine.session import EngineSession
+    from fishnet_tpu.obs.metrics import MetricsRegistry
+    from fishnet_tpu.serve.server import ServeApp
+
+    problems = []
+    n = 4
+    moves = ["e2e4", "e7e5", "g1f3"]
+    body = {
+        "id": "cache-poison", "tenant": "chaos",
+        "positions": [{"fen": START, "moves": moves[:i]} for i in range(n)],
+        "depth": 2, "timeout_ms": 8000,
+    }
+
+    class _WarnLog(Logger):
+        def __init__(self):
+            super().__init__(verbose=0)
+            self.warnings = []
+
+        def warn(self, text: str) -> None:
+            self.warnings.append(text)
+            super().warn(text)
+
+    async def http_post(host, port, payload_obj):
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            payload = json.dumps(payload_obj).encode("utf-8")
+            head = (
+                f"POST /analyse HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode("latin-1") + payload)
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+        header, _, body_bytes = raw.partition(b"\r\n\r\n")
+        lines = header.decode("latin-1").split("\r\n")
+        status = int(lines[0].split(None, 2)[1])
+        headers = {}
+        for line in lines[1:]:
+            name, _, value = line.partition(":")
+            headers[name.strip().lower()] = value.strip()
+        return status, headers, (json.loads(body_bytes) if body_bytes else {})
+
+    def comparable(resp_body):
+        """The search-determined payload: wall-clock fields (time_s,
+        nps, request latency) legitimately differ between a cached
+        entry — which carries the ORIGINAL search's timings — and a
+        fresh run; bit-identity is over what the search decided."""
+        return [
+            {k: r.get(k)
+             for k in ("scores", "pvs", "best_move", "depth", "nodes")}
+            for r in resp_body.get("results", [])
+        ]
+
+    async def ask(cache):
+        """One request through a fresh serve front-end (each phase is
+        its own 'process'; only the cache directory is shared)."""
+        app = ServeApp(
+            EngineSession(PyEngine(max_depth=2), flavor=EngineFlavor.OFFICIAL),
+            cache=cache, registry=MetricsRegistry(), logger=Logger(verbose=0),
+        )
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            return await http_post(host, port, body)
+        finally:
+            await app.drain_and_stop()
+
+    # one identity fingerprint across every phase: same engine, same
+    # flavor — a restart must NOT read as a netswap
+    ident = engine_identity(PyEngine(max_depth=2), EngineFlavor.OFFICIAL)
+
+    with tempfile.TemporaryDirectory(prefix="chaos-cache-") as tmp:
+        entries = Path(tmp) / "entries"
+
+        print("== phase 1: reference run, cache off ==")
+        status, headers, ref = await ask(None)
+        if status != 200:
+            problems.append(f"reference: status {status}, expected 200")
+        if "x-fishnet-cache" in headers:
+            problems.append(
+                "reference: X-Fishnet-Cache header present with the "
+                "cache off"
+            )
+
+        print("== phase 2: cold fill through a persisted cache ==")
+        wl1 = _WarnLog()
+        cache1 = AnalysisCache(ident, directory=tmp, logger=wl1)
+        status, headers, cold = await ask(cache1)
+        if status != 200:
+            problems.append(f"cold fill: status {status}, expected 200")
+        if headers.get("x-fishnet-cache") != "miss":
+            problems.append(
+                "cold fill: X-Fishnet-Cache="
+                f"{headers.get('x-fishnet-cache')!r}, expected 'miss'"
+            )
+        if comparable(cold) != comparable(ref):
+            problems.append(
+                "cold fill: response differs from the cache-off run — "
+                "cold positions must be bit-identical"
+            )
+        if cache1.stats.fills != n:
+            problems.append(
+                f"cold fill: fills={cache1.stats.fills}, expected {n}"
+            )
+        payloads = sorted(p.name for p in entries.glob("*.json"))
+        if len(payloads) != n:
+            problems.append(
+                f"cold fill: {len(payloads)} persisted payloads, "
+                f"expected {n}"
+            )
+
+        print("== phase 3: corrupt one payload, restart, replay ==")
+        poisoned = payloads[0] if payloads else ""
+        if poisoned:
+            path = entries / poisoned
+            path.write_bytes(path.read_bytes()[:-4] + b"ruin")
+        wl2 = _WarnLog()
+        cache2 = AnalysisCache(ident, directory=tmp, logger=wl2)
+        if cache2.counters()["disk_entries"] != n:
+            problems.append(
+                "restart: persisted index did not survive — "
+                f"disk_entries={cache2.counters()['disk_entries']}, "
+                f"expected {n}"
+            )
+        if cache2.stats.invalidated:
+            problems.append(
+                "restart: a plain restart invalidated entries — the "
+                "identity fingerprint must be stable"
+            )
+        status, headers, warm = await ask(cache2)
+        if status != 200:
+            problems.append(f"poisoned replay: status {status}")
+        if comparable(warm) != comparable(ref):
+            problems.append(
+                "poisoned replay: response differs from the cache-off "
+                "run — the fallback search must be bit-identical"
+            )
+        if headers.get("x-fishnet-cache") != "partial":
+            problems.append(
+                "poisoned replay: X-Fishnet-Cache="
+                f"{headers.get('x-fishnet-cache')!r}, expected 'partial' "
+                f"({n - 1} hits + 1 quarantined fallback)"
+            )
+        c = cache2.counters()
+        if c["quarantined"] != 1:
+            problems.append(
+                f"poisoned replay: quarantined={c['quarantined']}, "
+                "expected exactly the one corrupted entry"
+            )
+        if c["disk_hits"] != n - 1:
+            problems.append(
+                f"poisoned replay: disk_hits={c['disk_hits']}, expected "
+                f"{n - 1} — the other entries must keep serving"
+            )
+        bad = sorted(p.name for p in entries.glob("*.bad"))
+        if bad != [poisoned + ".bad"]:
+            problems.append(
+                f"poisoned replay: quarantine files {bad!r}, expected "
+                f"exactly [{poisoned + '.bad'!r}]"
+            )
+        quarantine_warns = [
+            w for w in wl2.warnings if "integrity check failed" in w
+        ]
+        if len(quarantine_warns) != 1:
+            problems.append(
+                f"poisoned replay: {len(quarantine_warns)} quarantine "
+                "warnings, expected exactly one"
+            )
+
+        # the fallback search must have re-filled the poisoned entry:
+        # the same request again is all-hit, still bit-identical
+        status, headers, again = await ask(cache2)
+        if headers.get("x-fishnet-cache") != "hit" \
+                or comparable(again) != comparable(ref):
+            problems.append(
+                "re-fill: second replay after the quarantine is "
+                f"X-Fishnet-Cache={headers.get('x-fishnet-cache')!r} "
+                "(expected 'hit' — the fallback result must repair the "
+                "cache) or not bit-identical"
+            )
+        if cache2.stats.quarantined != 1:
+            problems.append(
+                "re-fill: a second quarantine happened on the replay — "
+                "corruption must cost exactly one"
+            )
+        print(f"cache: {cache2.counters()}")
+
+    print()
+    for msg in problems:
+        if args.format == "github":
+            print(f"::error title=chaos cache poison::{msg}")
+        else:
+            print(f"FAIL: {msg}")
+    if problems:
+        return 1
+    print("chaos cache poison: one corrupt payload cost exactly one "
+          "quarantine (.bad + one warning), every response stayed "
+          "bit-identical to cache-off, and the fallback re-filled the "
+          "entry")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos", description=__doc__,
@@ -1566,7 +1802,8 @@ def main(argv=None) -> int:
     p.add_argument("--scenario", nargs="?", const="ladder", default=None,
                    choices=["ladder", "fleet-member-loss", "request-trace",
                             "fleet-flap", "fleet-straggler-hedge",
-                            "burst-member-loss", "flap-under-load"],
+                            "burst-member-loss", "flap-under-load",
+                            "cache-poison"],
                    help="run an acceptance scenario and exit non-zero on "
                         "any delivery violation: `ladder` (default when "
                         "the flag is bare) is the session-recovery "
@@ -1599,6 +1836,8 @@ def main(argv=None) -> int:
         return asyncio.run(burst_member_loss_scenario(args))
     if args.scenario == "flap-under-load":
         return asyncio.run(flap_under_load_scenario(args))
+    if args.scenario == "cache-poison":
+        return asyncio.run(cache_poison_scenario(args))
     if args.trace_smoke:
         return asyncio.run(trace_smoke(args))
     return asyncio.run(replay(args))

@@ -92,6 +92,16 @@ class LoadProfile:
     positions: int = 2  # per analyse request
     depth: int = 1
     timeout_ms: int = 8000
+    # which POSITIONS the requests ask about (orthogonal to per-tenant
+    # demand): "sequential" walks distinct move-chain prefixes (every
+    # request is cold — the exactly-once ledger shape), "zipf" draws
+    # each request's position from a fixed pool with 1/rank^s weights —
+    # the head repeats constantly, the tail is near-unique, which is
+    # the population the analysis cache (fishnet_tpu/cache/) is built
+    # for and what the bench `cache_zipf` row replays
+    fingerprint_dist: str = "sequential"  # sequential | zipf
+    fingerprint_pool: int = 64
+    fingerprint_zipf_s: float = 1.1
 
 
 @dataclass(frozen=True)
@@ -104,6 +114,9 @@ class PlannedRequest:
     positions: int
     depth: int
     timeout_ms: int
+    # Zipf position rank (fingerprint_dist="zipf"); -1 keeps the
+    # sequential walk. Defaulted so pre-rank JSONL recordings replay.
+    rank: int = -1
 
 
 def rate_at(profile: LoadProfile, t: float) -> float:
@@ -151,6 +164,13 @@ def generate_schedule(profile: LoadProfile, seed: int) -> List[PlannedRequest]:
     for rank in range(max(profile.tenants, 1)):
         total += 1.0 / ((rank + 1) ** profile.zipf_s)
         cum.append(total)
+    fcum: Optional[List[float]] = None
+    if profile.fingerprint_dist == "zipf":
+        fcum = []
+        ftotal = 0.0
+        for rank in range(max(profile.fingerprint_pool, 1)):
+            ftotal += 1.0 / ((rank + 1) ** profile.fingerprint_zipf_s)
+            fcum.append(ftotal)
     schedule: List[PlannedRequest] = []
     t = 0.0
     while True:
@@ -168,6 +188,7 @@ def generate_schedule(profile: LoadProfile, seed: int) -> List[PlannedRequest]:
             positions=1 if kind == "bestmove" else profile.positions,
             depth=profile.depth,
             timeout_ms=profile.timeout_ms,
+            rank=_pick_tenant(rng, fcum) if fcum is not None else -1,
         ))
     return schedule
 
@@ -196,6 +217,7 @@ def load_schedule(path: str) -> List[PlannedRequest]:
                 positions=int(row.get("positions", 1)),
                 depth=int(row.get("depth", 1)),
                 timeout_ms=int(row.get("timeout_ms", 8000)),
+                rank=int(row.get("rank", -1)),
             ))
     schedule.sort(key=lambda r: r.at)
     return schedule
@@ -209,18 +231,37 @@ _LINE = ["e2e4", "e7e5", "g1f3", "b8c6", "f1b5", "a7a6",
          "b5a4", "g8f6", "e1g1", "f8e7", "f1e1", "b7b5"]
 
 
+def _position_for_rank(rank: int) -> dict:
+    """The rank'th distinct position: prefixes of _LINE first, then the
+    same prefixes again from a start FEN whose fullmove counter is
+    bumped — a legal position with a different content fingerprint, so
+    the pool extends past len(_LINE)+1 without aliasing."""
+    block, rem = divmod(rank, len(_LINE) + 1)
+    fen = START if block == 0 else START.rsplit(" ", 1)[0] + f" {1 + block}"
+    return {"fen": fen, "moves": _LINE[:rem]}
+
+
 def request_body(req: PlannedRequest, index: int) -> dict:
     """The serve/protocol.py JSON body for one planned request.
     Distinct move chains give distinct position fingerprints, so the
     exactly-once ledger sees real entries, and the body is a pure
-    function of (req, index) — replay submits identical bytes."""
+    function of (req, index) — replay submits identical bytes. A
+    Zipf-ranked request (req.rank >= 0) instead asks about its ranked
+    pool position, so the hot head of the pool repeats across the run
+    the way real opening traffic does."""
+    if req.rank >= 0:
+        positions = [
+            _position_for_rank(req.rank + i) for i in range(req.positions)
+        ]
+    else:
+        positions = [
+            {"fen": START, "moves": _LINE[: (index + i) % (len(_LINE) + 1)]}
+            for i in range(req.positions)
+        ]
     body = {
         "id": f"lg-{index:06d}",
         "tenant": req.tenant,
-        "positions": [
-            {"fen": START, "moves": _LINE[: (index + i) % (len(_LINE) + 1)]}
-            for i in range(req.positions)
-        ],
+        "positions": positions,
         "depth": req.depth,
         "timeout_ms": req.timeout_ms,
     }
@@ -414,6 +455,9 @@ def profile_from_args(args: argparse.Namespace) -> LoadProfile:
         positions=args.positions,
         depth=args.depth,
         timeout_ms=args.timeout_ms,
+        fingerprint_dist=args.fingerprint_dist,
+        fingerprint_pool=args.fingerprint_pool,
+        fingerprint_zipf_s=args.fingerprint_zipf_s,
     )
 
 
@@ -448,6 +492,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="positions per analyse request")
     p.add_argument("--depth", type=int, default=1)
     p.add_argument("--timeout-ms", type=int, default=8000)
+    p.add_argument("--fingerprint-dist", default="sequential",
+                   choices=["sequential", "zipf"],
+                   help="position population: sequential (all-cold "
+                        "walk) or zipf (requests draw from a ranked "
+                        "pool with 1/rank^s weights — the analysis-"
+                        "cache workload)")
+    p.add_argument("--fingerprint-pool", type=int, default=64,
+                   help="zipf fingerprints: distinct positions in the "
+                        "ranked pool")
+    p.add_argument("--fingerprint-zipf-s", type=float, default=1.1,
+                   help="zipf fingerprints: Zipf exponent over the "
+                        "position pool")
     p.add_argument("--seed", type=int, default=0,
                    help="schedule seed; same seed, same schedule")
     p.add_argument("--record", metavar="FILE",
