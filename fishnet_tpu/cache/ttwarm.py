@@ -42,6 +42,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..client.logger import Logger
+from ..utils import sanitize
 
 # rows per slice: the root + up to this many child slots
 MAX_SLICE_ROWS = 48
@@ -118,6 +119,11 @@ class TTWarmStore:
         self.warm_slots = 0
         self.exports = 0
         self.quarantined = 0
+        # FISHNET_TPU_SANITIZE, captured once: verify that rows entering
+        # and leaving the store decode to STORABLE entries (flag != 3,
+        # |score| within the store clamp). The sha256 gate catches bit
+        # rot; this catches a writer exporting garbage that hashes fine.
+        self._sanitize = sanitize.enabled()
         self._dir: Optional[Path] = None
         if directory is not None:
             self._dir = Path(directory) / "tt"
@@ -140,6 +146,9 @@ class TTWarmStore:
             rows = self._load(mk)
             if rows is None:
                 return []
+            if self._sanitize:
+                sanitize.check_tt_rows(
+                    rows, "cache/ttwarm.py::TTWarmStore.lookup")
             self._insert(mk, rows)
             return [list(r) for r in rows]
 
@@ -149,6 +158,9 @@ class TTWarmStore:
         per slot — they come from a fresher search)."""
         if not rows:
             return
+        if self._sanitize:
+            sanitize.check_tt_rows(
+                rows, "cache/ttwarm.py::TTWarmStore.record")
         mk = self._mem_key(size_log2, key)
         with self._lock:
             merged = {

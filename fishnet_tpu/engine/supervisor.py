@@ -66,6 +66,7 @@ from ..client.ipc import (
 )
 from ..client.logger import Logger
 from ..obs import trace as obs_trace
+from ..utils import sanitize
 from ..utils import settings
 from .base import EngineError
 from .frames import FrameError, PipeClosed, encode, read_frame_async
@@ -243,6 +244,10 @@ class SupervisedEngine(ChunkSubmit):
         self._journal: Dict[str, dict] = {}
         self._journal_expect: Set[str] = set()
         self._last_partial: Optional[float] = None
+        # FISHNET_TPU_SANITIZE, captured once: duplicate partials then
+        # verify payload consistency (identical replay is designed;
+        # a DIFFERENT answer for a journaled fingerprint is a bug)
+        self._sanitize = sanitize.enabled()
         # poison positions (by content fingerprint), routed individually
         # to the CPU fallback for the rest of this process's life
         self._quarantine: Set[str] = set()
@@ -622,6 +627,10 @@ class SupervisedEngine(ChunkSubmit):
         if fp not in self._journal_expect:
             return  # stale or alien fingerprint
         if fp in self._journal:
+            if self._sanitize:
+                sanitize.check_replay_consistent(
+                    self._journal, fp, wire,
+                    "engine/supervisor.py::_journal_record")
             self.stats.duplicate_partials += 1
             return  # exactly-once: re-sent partials are ignored
         self._journal[fp] = wire

@@ -32,6 +32,7 @@ from ..obs import inflight as obs_inflight
 from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 from ..ops.search import INF, MATE, search_batch_resumable
+from ..utils import sanitize
 from ..utils import settings
 from ..utils.syncstats import SegmentController, SyncStats
 from .base import EngineError
@@ -1448,6 +1449,9 @@ class LaneScheduler:
         self._pending: List[_RefillJob] = []
         self._driving = False
         self._jitter_seq = 0
+        # FISHNET_TPU_SANITIZE, captured once: _deliver pays a single
+        # attribute test per position, nothing per boundary
+        self._sanitize = sanitize.enabled()
 
     # ------------------------------------------------------- submission
 
@@ -1601,6 +1605,10 @@ class LaneScheduler:
         finalized response — terminal shortcut or searched — lands in
         `entry.responses` through here, and only here, so the
         `on_response` streaming hook fires once per position."""
+        if self._sanitize:
+            sanitize.check_delivery_once(
+                entry.responses, wp.position_index,
+                "engine/tpu.py::LaneScheduler._deliver")
         entry.responses[wp.position_index] = response
         ctx = wp.ctx
         if ctx and ctx.get("trace_id"):

@@ -250,12 +250,14 @@ class Autoscaler:
         """Stop the loop; a pending drain is left to the coordinator
         (close() tears members down anyway)."""
         self._stop.set()
-        if self._task is not None:
+        # claim the task before awaiting: a second concurrent stop()
+        # sees None and returns instead of cancelling a cleared slot
+        task, self._task = self._task, None
+        if task is not None:
             try:
-                await asyncio.wait_for(self._task, timeout=10.0)
+                await asyncio.wait_for(task, timeout=10.0)
             except asyncio.TimeoutError:
-                self._task.cancel()
-            self._task = None
+                task.cancel()
 
     async def _run(self) -> None:
         while not self._stop.is_set():
@@ -309,6 +311,10 @@ class Autoscaler:
         self.logger.info(f"autoscale: {action} ({reason}); "
                          f"members={members}")
 
+    # the control loop is the only writer of the streak/drain fields:
+    # _run() awaits each tick() to completion before the next, and the
+    # serve wiring never calls tick() concurrently with the loop
+    # fishnet-lint: single-writer
     async def tick(self) -> None:
         """One control-loop pass. Public so tests and the chaos harness
         can drive the loop deterministically without the timer."""

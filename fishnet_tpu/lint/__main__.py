@@ -3,6 +3,9 @@
     python -m fishnet_tpu.lint                    # lint the repo
     python -m fishnet_tpu.lint --format=github    # CI annotations
     python -m fishnet_tpu.lint --write-baseline   # absolve current findings
+    python -m fishnet_tpu.lint --changed          # findings in dirty files
+    python -m fishnet_tpu.lint --changed origin/main   # ...vs a base ref
+    python -m fishnet_tpu.lint --explain trace-sync    # docs for one rule
     python -m fishnet_tpu.lint --list-rules
 
 Exit codes: 0 clean (or everything baselined), 1 active findings or a
@@ -12,9 +15,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from .core import Project, dump_baseline, families, load_baseline, run_lint
 
@@ -25,6 +29,69 @@ def _detect_root() -> Path:
     import fishnet_tpu
 
     return Path(fishnet_tpu.__file__).resolve().parents[1]
+
+
+def _changed_files(root: Path, base: str) -> Set[str]:
+    """Root-relative paths of files changed vs `base`, plus untracked
+    files — the pre-push view of 'what did I touch'."""
+    out: Set[str] = set()
+    for cmd in (
+        ["git", "diff", "--name-only", base, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            cmd, cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)}: {proc.stderr.strip() or 'failed'}"
+            )
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return out
+
+
+def _explain(root: Path, rule: str) -> int:
+    """Print the docs/lint.md entry for a rule (table row) or a whole
+    rule-family section; the docs are the single source of rule prose,
+    so this never drifts from them."""
+    doc = root / "docs" / "lint.md"
+    if not doc.is_file():
+        print(f"fishnet-lint: {doc} not found", file=sys.stderr)
+        return 2
+    lines = doc.read_text(encoding="utf-8").splitlines()
+    # family section: print everything from its `### \`name\`` heading
+    # to the next heading
+    sect_start = None
+    for i, line in enumerate(lines):
+        if line.startswith("### ") and f"`{rule}`" in line.split("—")[0]:
+            sect_start = i
+            break
+    if sect_start is not None:
+        for line in lines[sect_start + 1:]:
+            if line.startswith(("## ", "### ")):
+                break
+            print(line)
+        return 0
+    # single rule: its table row, plus the owning section heading
+    heading = ""
+    for line in lines:
+        if line.startswith("### "):
+            heading = line[4:].strip()
+        if line.startswith(f"| `{rule}` |"):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            print(f"{rule} (family: {heading})")
+            print()
+            print(f"Fires on: {cells[1] if len(cells) > 1 else ''}")
+            print()
+            print(f"Suppress inline with `# fishnet-lint: disable={rule}` "
+                  f"(same line or the comment line above); full docs in "
+                  f"docs/lint.md.")
+            return 0
+    print(f"fishnet-lint: no docs entry for rule {rule!r} — see "
+          f"--list-rules for families and docs/lint.md for rules",
+          file=sys.stderr)
+    return 2
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -57,7 +124,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--list-rules", action="store_true",
         help="list rule families and exit",
     )
+    parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="BASE",
+        help="only report findings in files changed vs BASE (default "
+             "HEAD: working-tree changes plus untracked files); the whole "
+             "project is still parsed so cross-file rules see full context",
+    )
+    parser.add_argument(
+        "--explain", metavar="RULE", default=None,
+        help="print the docs/lint.md entry for a rule or rule family "
+             "and exit",
+    )
     args = parser.parse_args(argv)
+
+    if args.explain:
+        return _explain((args.root or _detect_root()).resolve(),
+                        args.explain)
 
     if args.list_rules:
         # importing run_lint's rule modules registers the families
@@ -65,6 +147,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from . import cache_rules  # noqa: F401
         from . import concurrency_rules  # noqa: F401
         from . import config_rules  # noqa: F401
+        from . import dataflow_rules  # noqa: F401
         from . import obs_rules  # noqa: F401
         from . import trace_rules  # noqa: F401
         from . import wire_rules  # noqa: F401
@@ -93,6 +176,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     only = set(args.only_families) if args.only_families else None
     result = run_lint(project, baseline=baseline, only_families=only)
+
+    if args.changed is not None:
+        # scope the REPORT, not the analysis: cross-file rules (config
+        # registry, wire pairs) already saw the whole project above. A
+        # diff-scoped run also can't judge baseline staleness, so stale
+        # entries neither print nor fail here.
+        try:
+            changed = _changed_files(root, args.changed)
+        except (RuntimeError, OSError, subprocess.SubprocessError) as e:
+            print(f"fishnet-lint: --changed: {e}", file=sys.stderr)
+            return 2
+        result.findings = [f for f in result.findings if f.path in changed]
+        result.stale_baseline = []
 
     if args.write_baseline:
         baseline_path.write_text(dump_baseline(result.active),

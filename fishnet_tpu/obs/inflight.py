@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils import sanitize
+
 __all__ = ["InflightRegistry", "REGISTRY"]
 
 # Stage ordering for the coarse request-level stage: position updates
@@ -46,6 +48,12 @@ class InflightRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._entries: Dict[str, dict] = {}
+        # FISHNET_TPU_SANITIZE, captured once: an unknown stage label is
+        # a typo'd call site that would silently rank 0 and vanish from
+        # the ordering — strict mode rejects it. Backward stage moves
+        # stay CLAMPED, never raised: re-dispatch after member loss
+        # legitimately replays positions through earlier stages.
+        self._strict = sanitize.enabled()
 
     def begin(self, trace_id: str, req_id: str, tenant: str, kind: str,
               deadline_mono_s: Optional[float] = None,
@@ -68,6 +76,11 @@ class InflightRegistry:
     def stage(self, trace_id: Optional[str], stage: str) -> None:
         if not trace_id:
             return
+        if self._strict and stage not in _STAGE_RANK:
+            raise sanitize.SanitizeError(
+                f"sanitize[obs/inflight.py::stage]: unknown stage label "
+                f"{stage!r} (known: {', '.join(_STAGE_ORDER)})"
+            )
         with self._lock:
             entry = self._entries.get(trace_id)
             if entry is None:
@@ -82,6 +95,11 @@ class InflightRegistry:
         own stage plus the lane it occupies once spliced."""
         if not trace_id:
             return
+        if self._strict and stage not in _STAGE_RANK:
+            raise sanitize.SanitizeError(
+                f"sanitize[obs/inflight.py::position]: unknown stage label "
+                f"{stage!r} (known: {', '.join(_STAGE_ORDER)})"
+            )
         with self._lock:
             entry = self._entries.get(trace_id)
             if entry is None:

@@ -56,6 +56,7 @@ warnings.filterwarnings(
 
 from ..aot import registry as _aot_registry
 from ..models import nnue
+from ..utils import sanitize as _sanitize
 from ..utils import settings
 from .board import (
     TERM_LOSS,
@@ -1077,6 +1078,18 @@ _merge_lanes_jit = _aot_registry.wrap(
     jax.jit(_merge_lanes, donate_argnums=(0, 1)),
     _merge_lanes,
 )
+
+# FISHNET_TPU_SANITIZE: poison donated inputs after dispatch so a
+# use-after-donate raises on CPU too (XLA:CPU only warns and leaves the
+# handles readable). guard_donation returns each jit UNCHANGED when the
+# flag is off — the default path pays nothing. docs/sanitizer.md.
+_run_segment_jit = _sanitize.guard_donation(
+    "ops/search.py::_run_segment_jit", _run_segment_jit, argnums=(1, 2))
+_init_state_jit = _sanitize.guard_donation(
+    "ops/search.py::_init_state_jit", _init_state_jit,
+    argnames=("hist_hash", "hist_halfmove"))
+_merge_lanes_jit = _sanitize.guard_donation(
+    "ops/search.py::_merge_lanes_jit", _merge_lanes_jit, argnums=(0, 1))
 
 
 def _refill_fresh(params: nnue.NnueParams, state: SearchState,

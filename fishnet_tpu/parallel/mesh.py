@@ -17,6 +17,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..aot import registry as _aot_registry
+from ..utils import sanitize as _sanitize
 
 try:
     _shard_map = jax.shard_map
@@ -94,14 +95,20 @@ def _segment_callable(mesh: Mesh, axis: str, has_tt: bool,
         **_SHARD_MAP_KW,
     )
     # AOT-wrapped (fishnet_tpu/aot/): the shard_map closure's compile
-    # flags become extra key material — all call arguments are dynamic
-    return _aot_registry.wrap(
-        "mesh_segment", jax.jit(fn, donate_argnums=(1, 2)), seg,
-        extra_static={
-            "mesh": "x".join(str(d) for d in mesh.devices.shape),
-            "axis": axis, "has_tt": has_tt, "variant": variant,
-            "deep_tt": deep_tt, "prefer_deep": prefer_deep,
-        },
+    # flags become extra key material — all call arguments are dynamic.
+    # The donation guard is a no-op unless FISHNET_TPU_SANITIZE is set,
+    # and lru_cache means it wraps once per mesh config, not per call.
+    return _sanitize.guard_donation(
+        "parallel/mesh.py::mesh_segment",
+        _aot_registry.wrap(
+            "mesh_segment", jax.jit(fn, donate_argnums=(1, 2)), seg,
+            extra_static={
+                "mesh": "x".join(str(d) for d in mesh.devices.shape),
+                "axis": axis, "has_tt": has_tt, "variant": variant,
+                "deep_tt": deep_tt, "prefer_deep": prefer_deep,
+            },
+        ),
+        argnums=(1, 2),
     )
 
 
@@ -150,12 +157,16 @@ def _merge_callable(mesh: Mesh, axis: str):
         out_specs=P(axis),
         **_SHARD_MAP_KW,
     )
-    return _aot_registry.wrap(
-        "mesh_merge", jax.jit(fn, donate_argnums=(0, 1)), _merge_lanes,
-        extra_static={
-            "mesh": "x".join(str(d) for d in mesh.devices.shape),
-            "axis": axis,
-        },
+    return _sanitize.guard_donation(
+        "parallel/mesh.py::mesh_merge",
+        _aot_registry.wrap(
+            "mesh_merge", jax.jit(fn, donate_argnums=(0, 1)), _merge_lanes,
+            extra_static={
+                "mesh": "x".join(str(d) for d in mesh.devices.shape),
+                "axis": axis,
+            },
+        ),
+        argnums=(0, 1),
     )
 
 

@@ -1,0 +1,250 @@
+"""Runtime invariant sanitizer, behind ``FISHNET_TPU_SANITIZE``.
+
+The static side of this PR's tooling (lint/dataflow_rules.py) proves
+what it can about donated-buffer lifetimes and exactly-once ledgers
+without running anything; this module is the dynamic complement for
+what static analysis cannot see — donation routed through data,
+double deliveries produced by a fault path, decayed TT rows read back
+from disk. See docs/sanitizer.md for the full catalogue and cost
+model.
+
+Zero-overhead-off contract: every hook in the production modules is
+gated on a flag captured ONCE (at module import or object
+construction, via :func:`enabled`). With the flag off — the default —
+``guard_donation`` returns the wrapped callable *unchanged* and the
+ledger/stage/TT checks are a single pre-captured boolean test on cold
+paths, so results are bit-identical and the pipelined scheduler loop
+gains no per-boundary work. Flipping the setting therefore requires a
+fresh process (the chaos sanitize CI tier sets it in the environment
+before spawning anything).
+
+Donation poisoning: JAX only *warns* when a donated buffer is not
+usable (XLA:CPU), so the exact bug class that donation introduces —
+reading an input handle after the dispatch that consumed it — can
+survive the whole CPU test tier. ``guard_donation`` probes every
+donated input leaf with ``is_deleted`` after the call and explicitly
+``delete()``\\ s the ones the platform left alive, recording the
+donating call site. A later read raises from JAX itself; passing the
+dead handle back into any guarded call raises :class:`SanitizeError`
+naming the call site that donated it.
+
+Pure stdlib at import time: JAX is imported lazily inside the
+donation guard only, so the serve/fleet/supervisor processes (which
+never import JAX) can run fully sanitized.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+__all__ = [
+    "SanitizeError",
+    "enabled",
+    "guard_donation",
+    "deleted_site",
+    "check_delivery_once",
+    "check_replay_consistent",
+    "check_tt_rows",
+    "TT_SAMPLE_STRIDE",
+]
+
+
+class SanitizeError(AssertionError):
+    """An invariant the sanitizer watches was violated."""
+
+
+def enabled() -> bool:
+    """Read ``FISHNET_TPU_SANITIZE`` through the settings registry.
+
+    Call sites capture the result once (module import / constructor) —
+    never per boundary — so the off-mode cost is zero.
+    """
+    from . import settings
+
+    return settings.get_bool("FISHNET_TPU_SANITIZE")
+
+
+# ------------------------------------------------------------- donation
+
+# id(leaf) -> donating site, for diagnostics. Bounded: this is a debug
+# mode, and a stale label after id() reuse only blurs a message.
+_MAX_SITES = 4096
+_DONATED_SITES: Dict[int, str] = {}
+
+
+def _record_site(leaf: Any, site: str) -> None:
+    if len(_DONATED_SITES) >= _MAX_SITES:
+        _DONATED_SITES.clear()
+    _DONATED_SITES[id(leaf)] = site
+
+
+def deleted_site(leaf: Any) -> Optional[str]:
+    """The guarded call that donated this array, if the sanitizer saw
+    it (diagnostic aid for 'Array has been deleted' tracebacks)."""
+    return _DONATED_SITES.get(id(leaf))
+
+
+class _DonationGuard:
+    """Callable wrapper that poisons donated inputs after dispatch.
+
+    Attribute access (``.lower``, AOT registry metadata, ...) forwards
+    to the wrapped callable so tooling built on the bare jits keeps
+    working under the sanitizer.
+    """
+
+    def __init__(self, site: str, fn: Callable,
+                 argnums: Sequence[int], argnames: Sequence[str]) -> None:
+        self._site = site
+        self._fn = fn
+        self._argnums = tuple(argnums)
+        self._argnames = tuple(argnames)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._fn, name)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        import jax
+
+        donated = [args[i] for i in self._argnums if i < len(args)]
+        donated += [kwargs[n] for n in self._argnames if n in kwargs]
+        leaves = [
+            leaf
+            for operand in donated
+            for leaf in jax.tree_util.tree_leaves(operand)
+            if isinstance(leaf, jax.Array)
+        ]
+        # pre-call probe: a handle someone already donated is being
+        # passed back in — raise here, naming the donating site, before
+        # JAX produces its siteless "Array has been deleted"
+        for leaf in leaves:
+            if leaf.is_deleted():
+                prior = _DONATED_SITES.get(
+                    id(leaf), "an earlier donating call")
+                raise SanitizeError(
+                    f"sanitize[{self._site}]: a donated input buffer is "
+                    f"already dead — it was donated into {prior}; rebind "
+                    f"the variable from that call's outputs"
+                )
+        out = self._fn(*args, **kwargs)
+        # poison the donated inputs the platform left alive, but never
+        # a buffer the call aliased into its outputs
+        out_ids = set()
+        out_ptrs = set()
+        for leaf in jax.tree_util.tree_leaves(out):
+            if isinstance(leaf, jax.Array):
+                out_ids.add(id(leaf))
+                try:
+                    out_ptrs.add(leaf.unsafe_buffer_pointer())
+                except Exception:
+                    pass  # sharded/committed arrays may not expose one
+        for leaf in leaves:
+            if leaf.is_deleted() or id(leaf) in out_ids:
+                _record_site(leaf, self._site)
+                continue
+            try:
+                ptr: Optional[int] = leaf.unsafe_buffer_pointer()
+            except Exception:
+                ptr = None
+            if ptr is not None and ptr in out_ptrs:
+                continue
+            leaf.delete()
+            _record_site(leaf, self._site)
+        return out
+
+
+def guard_donation(site: str, fn: Callable, argnums: Sequence[int] = (),
+                   argnames: Sequence[str] = (),
+                   force: Optional[bool] = None) -> Callable:
+    """Wrap a donating jit so its donated inputs die loudly.
+
+    Returns ``fn`` unchanged when the sanitizer is off (the structural
+    zero-overhead guarantee). ``force`` overrides the setting for
+    tests.
+    """
+    on = enabled() if force is None else force
+    if not on:
+        return fn
+    return _DonationGuard(site, fn, argnums, argnames)
+
+
+# -------------------------------------------------- exactly-once ledgers
+
+def check_delivery_once(ledger: Mapping, key: Any, site: str) -> None:
+    """Strict exactly-once: the key must not already be in the ledger.
+
+    For delivery points whose downstream effects (streaming hooks,
+    trace events) must fire exactly once per key — a duplicate is a bug
+    even when the payload matches.
+    """
+    if key in ledger:
+        raise SanitizeError(
+            f"sanitize[{site}]: double delivery for {key!r} — the "
+            f"exactly-once ledger already holds a response for it"
+        )
+
+
+def check_replay_consistent(ledger: Mapping, key: Any, value: Any,
+                            site: str) -> None:
+    """Replay-tolerant exactly-once: re-delivering the SAME payload is
+    designed (journal replay after a respawn resends partials); the
+    same key with a DIFFERENT payload means two answers were computed
+    for one fingerprint."""
+    prior = ledger.get(key)
+    if prior is not None and prior is not value and prior != value:
+        raise SanitizeError(
+            f"sanitize[{site}]: conflicting re-delivery for {key!r} — "
+            f"the ledger holds a different response for this "
+            f"fingerprint (double search or cross-wired replay)"
+        )
+
+
+# --------------------------------------------------------- TT integrity
+
+# 1-in-N sampling stride for row verification (docs/sanitizer.md).
+TT_SAMPLE_STRIDE = 64
+
+# ops/tt.py invariants: store() never writes FLAG 3, clamps depth into
+# its 8-bit field, and refuses |score| beyond the mate margin.
+_TT_MAX_STORE_SCORE = 30_000
+_TT_SCORE_BIAS = 32_768
+
+
+def check_tt_rows(rows: Sequence[Sequence[int]], site: str,
+                  stride: int = TT_SAMPLE_STRIDE) -> int:
+    """Verify sampled TT rows decode to values store() could have
+    written.
+
+    The check/meta/move XOR (``check == h2 ^ meta ^ move``) cannot be
+    re-verified host-side without the probing position's hash, so the
+    sanitizer checks the complement: every occupied row's meta word
+    must unpack to a flag store() writes (0/1/2 — never 3), a score
+    inside the mate margin, and a depth inside the packed field. A row
+    violating this cannot have come from ops/tt.py's store path — it
+    is corruption (or a packing regression) that the XOR would merely
+    convert into silent probe misses.
+
+    Rows are ``[slot?, check, meta, move, gen]`` (cache/ttwarm.py
+    extract format) or ``[check, meta, move, gen]`` (raw table rows).
+    Returns the number of rows actually verified.
+    """
+    checked = 0
+    stride = max(1, int(stride))
+    for i in range(0, len(rows), stride):
+        row = rows[i]
+        check, meta, move = (
+            (int(row[1]), int(row[2]), int(row[3])) if len(row) >= 5
+            else (int(row[0]), int(row[1]), int(row[2]))
+        )
+        if check == 0 and meta == 0 and move == 0:
+            continue  # empty slot
+        # mirror ops/tt.py unpack_meta exactly
+        flag = meta & 0x3
+        depth = (meta >> 2) & 0xFF
+        score = (meta >> 10) - _TT_SCORE_BIAS
+        if flag == 3 or abs(score) > _TT_MAX_STORE_SCORE:
+            raise SanitizeError(
+                f"sanitize[{site}]: TT row {i} does not decode to a "
+                f"storable entry (flag={flag} score={score} "
+                f"depth={depth}) — corrupt or mis-packed meta word"
+            )
+        checked += 1
+    return checked

@@ -595,6 +595,20 @@ SETTINGS: Tuple[Setting, ...] = (
             "positions sharing this many first moves share a slice.",
         engine=True,
     ),
+    Setting(
+        name="FISHNET_TPU_SANITIZE",
+        kind="bool",
+        default="0",
+        doc="Runtime invariant sanitizer (utils/sanitize.py, "
+            "docs/sanitizer.md): poison donated jit inputs so "
+            "use-after-donate raises on CPU too, assert the "
+            "exactly-once delivery ledgers never double-deliver, "
+            "reject unknown in-flight stage labels, and verify "
+            "sampled TT warm rows decode to storable entries. "
+            "Captured at import/construction — flipping it needs a "
+            "fresh process. Off (default) adds zero overhead.",
+        engine=True,
+    ),
 )
 
 _BY_NAME: Dict[str, Setting] = {s.name: s for s in SETTINGS}

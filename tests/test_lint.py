@@ -1269,3 +1269,436 @@ async def tick():
         tmp_path, {"fishnet_tpu/fleet/autoscaler.py": src})
     result = run_lint(project, only_families={"concurrency"})
     assert "conc-sock-in-loop" in rules_of(result.findings)
+
+
+# ---------------------------------------------- dataflow: use-after-donate
+
+
+DONATE_BAD = '''
+def step(params, state, tt, steps):
+    out = _run_segment_jit(params, state, tt, steps)
+    lanes = state.lane          # jit-donate-use-after: never rebound
+    return out, lanes
+'''
+
+DONATE_GOOD = '''
+def step(params, state, tt, steps):
+    state, tt, n, summ = _run_segment_jit(params, state, tt, steps)
+    lanes = state.lane          # ok: reads the rebound state
+    return state, tt, n, lanes
+'''
+
+
+def test_donate_use_after_flags_unrebound_read(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": DONATE_BAD})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "jit-donate-use-after")
+    assert len(found) == 1 and found[0].line == 4
+    assert "_run_segment_jit() at line 3" in found[0].message
+
+
+def test_donate_rebind_discipline_is_clean(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": DONATE_GOOD})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "jit-donate-use-after") == []
+
+
+def test_donate_if_join_intersects(tmp_path):
+    # the pipelined-scheduler shape: donate speculatively on one branch,
+    # read the same name only on the mutually exclusive other branch —
+    # dead on ONE path must not poison the join
+    src = '''
+def step(params, state, tt, steps, pipelined):
+    if pipelined:
+        nxt = _run_segment_jit(params, state, tt, steps)
+    else:
+        nxt = (state, tt)
+    probe = state.lane           # live on the else path: no finding
+    return nxt, probe
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": src})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "jit-donate-use-after") == []
+
+
+def test_donate_loop_carried_donation_is_caught(tmp_path):
+    # a donation at the body's tail reaches the read at its head on the
+    # next iteration — the two-pass loop analysis
+    src = '''
+def drive(params, state, tt, steps, n_chunks):
+    for _ in range(n_chunks):
+        lanes = state.lane       # dead on iteration 2+
+        out = _run_segment_jit(params, state, tt, steps)
+    return out
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": src})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "jit-donate-use-after")
+    # line 4: the loop-carried read; line 5: the dead name passed back
+    # into the donating call itself (also a buffer use)
+    assert [f.line for f in found] == [4, 5]
+
+
+def test_donate_alias_propagates_without_flagging(tmp_path):
+    # `y = state` after donation copies the dead handle — the alias
+    # itself is not a buffer read, but reading THROUGH it is
+    src = '''
+def step(params, state, tt, steps):
+    out = _run_segment_jit(params, state, tt, steps)
+    y = state                   # alias: no finding here
+    lanes = y.lane              # finding: reads the dead buffer
+    return out, lanes
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": src})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "jit-donate-use-after")
+    assert [f.line for f in found] == [5]
+
+
+def test_donate_module_level_jit_registration(tmp_path):
+    # a module-local `jax.jit(..., donate_argnums=...)` assignment joins
+    # the registry for that module, whatever it is named
+    src = '''
+import jax
+
+
+def _merge(a, b):
+    return a + b
+
+
+_local_jit = jax.jit(_merge, donate_argnums=(0,))
+
+
+def run(a, b):
+    c = _local_jit(a, b)
+    return a + c                 # `a` was donated at position 0
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/driver.py": src})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "jit-donate-use-after")
+    assert [f.line for f in found] == [14]
+    assert "_local_jit() at line 13" in found[0].message
+
+
+def test_donate_scope_excludes_tests(tmp_path):
+    # tests/ deliberately poke dead handles (the is_deleted regression
+    # tests assert the read RAISES)
+    project = make_project(tmp_path, {"tests/test_x.py": DONATE_BAD})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "jit-donate-use-after") == []
+
+
+def test_mutated_search_driver_is_caught(tmp_path):
+    # both directions on the REAL scheduler code: unmutated ops/search.py
+    # is clean, and un-rebinding the segment dispatch (the PR-5 bug
+    # shape) is flagged
+    text = (REPO_ROOT / "fishnet_tpu/ops/search.py").read_text(
+        encoding="utf-8")
+    project = make_project(tmp_path, {"fishnet_tpu/ops/search.py": text})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "jit-donate-use-after") == []
+
+    mutated = text.replace(
+        "            state, tt, n, _summ = _run_segment_jit(",
+        "            state2, tt, n, _summ = _run_segment_jit(",
+    )
+    assert mutated != text
+    project = make_project(
+        tmp_path / "mut", {"fishnet_tpu/ops/search.py": mutated})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "jit-donate-use-after")
+    assert found and all("_run_segment_jit" in f.message for f in found)
+
+
+# ------------------------------------------ dataflow: await-shared-mutate
+
+
+STRADDLE_BAD = '''
+async def tick(self):
+    if self._streak > 3:         # read ...
+        await self.scale_up()    # ... suspension ...
+        self._streak = 0         # ... write: check-then-act race
+'''
+
+STRADDLE_LOCKED = '''
+async def tick(self):
+    async with self._lock:
+        if self._streak > 3:
+            await self.scale_up()
+            self._streak = 0
+'''
+
+STRADDLE_ANNOTATED = '''
+# fishnet-lint: single-writer
+async def tick(self):
+    if self._streak > 3:
+        await self.scale_up()
+        self._streak = 0
+'''
+
+STRADDLE_SYNC_HELPER = '''
+async def tick(self):
+    def bump():
+        if self._streak > 3:
+            self._streak = 0
+    await self.scale_up()
+    bump()
+'''
+
+
+def test_await_straddle_flagged(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/autoscaler.py": STRADDLE_BAD})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "conc-await-shared-mutate")
+    assert [f.line for f in found] == [5]
+    assert "self._streak" in found[0].message
+
+
+def test_await_straddle_lock_annotation_and_helper_pass(tmp_path):
+    project = make_project(tmp_path, {
+        "fishnet_tpu/fleet/a.py": STRADDLE_LOCKED,
+        "fishnet_tpu/fleet/b.py": STRADDLE_ANNOTATED,
+        "fishnet_tpu/serve/c.py": STRADDLE_SYNC_HELPER,
+    })
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "conc-await-shared-mutate") == []
+
+
+def test_await_straddle_augassign_is_atomic(tmp_path):
+    # stats counters: the += read-modify-write happens at ONE point
+    src = '''
+async def record(self):
+    n = self.stats.ticks
+    await self.flush(n)
+    self.stats.ticks += 1
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/serve/s.py": src})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "conc-await-shared-mutate") == []
+
+
+def test_await_straddle_scope_is_async_serve_fleet_cache(tmp_path):
+    # same shape outside the event-loop dirs, or in a sync def: clean
+    sync_src = STRADDLE_BAD.replace("async def", "def").replace(
+        "await ", "")
+    project = make_project(tmp_path, {
+        "fishnet_tpu/engine/e.py": STRADDLE_BAD,
+        "fishnet_tpu/fleet/s.py": sync_src,
+    })
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "conc-await-shared-mutate") == []
+
+
+def test_mutated_autoscaler_race_is_caught(tmp_path):
+    # both directions on the REAL control loop: as-committed it is clean
+    # (stop() claims the task before awaiting; tick() is annotated), and
+    # reintroducing the stop() check-then-act race is flagged
+    text = (REPO_ROOT / "fishnet_tpu/fleet/autoscaler.py").read_text(
+        encoding="utf-8")
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/autoscaler.py": text})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "conc-await-shared-mutate") == []
+
+    racy = """\
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=10.0)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+            self._task = None
+"""
+    fixed = """\
+        # claim the task before awaiting: a second concurrent stop()
+        # sees None and returns instead of cancelling a cleared slot
+        task, self._task = self._task, None
+        if task is not None:
+            try:
+                await asyncio.wait_for(task, timeout=10.0)
+            except asyncio.TimeoutError:
+                task.cancel()
+"""
+    mutated = text.replace(fixed, racy)
+    assert mutated != text
+    project = make_project(
+        tmp_path / "mut", {"fishnet_tpu/fleet/autoscaler.py": mutated})
+    result = run_lint(project, only_families={"dataflow"})
+    found = by_rule(result.findings, "conc-await-shared-mutate")
+    assert found and any("self._task" in f.message for f in found)
+
+
+def test_stripped_single_writer_annotation_is_caught(tmp_path):
+    # the annotation carries the tick() exemption; removing it without
+    # adding a lock re-exposes the straddles
+    text = (REPO_ROOT / "fishnet_tpu/fleet/autoscaler.py").read_text(
+        encoding="utf-8")
+    mutated = text.replace("    # fishnet-lint: single-writer\n", "")
+    assert mutated != text
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/autoscaler.py": mutated})
+    result = run_lint(project, only_families={"dataflow"})
+    assert by_rule(result.findings, "conc-await-shared-mutate")
+
+
+# ------------------------------------------------- lint-core edge cases
+
+
+def test_suppression_multi_rule_list(tmp_path):
+    src = '''
+import jax.numpy as jnp
+import jax
+
+
+def kernel(x):
+    # fishnet-lint: disable=trace-int-dtype,trace-host-item
+    y = jnp.arange(8).item()
+    return y
+
+
+run = jax.jit(kernel)
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/k.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert result.findings == []
+
+
+def test_suppression_above_decorated_def_governs_def_line(tmp_path):
+    # the comment-line-above rule governs the NEXT line only: above a
+    # decorator it reaches the decorator line, not findings inside the
+    # function — suppressions cannot blanket a whole def
+    src = '''
+import jax.numpy as jnp
+import jax
+
+
+# fishnet-lint: disable=trace-int-dtype
+@jax.jit
+def kernel(x):
+    return jnp.arange(8)
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/k.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert [f.rule for f in result.findings] == ["trace-int-dtype"]
+
+
+def test_suppression_on_continuation_line(tmp_path):
+    # findings anchor to the expression's first line; a suppression on
+    # the line ABOVE the statement works even when the expression spans
+    # several physical lines
+    src = '''
+import jax.numpy as jnp
+import jax
+
+
+def kernel(x):
+    # fishnet-lint: disable=trace-int-dtype
+    y = jnp.arange(
+        8,
+    )
+    return y
+
+
+run = jax.jit(kernel)
+'''
+    project = make_project(tmp_path, {"fishnet_tpu/ops/k.py": src})
+    result = run_lint(project, only_families={"trace"})
+    assert result.findings == []
+
+
+def test_baseline_round_trips_empty(tmp_path):
+    # zero findings -> empty baseline -> loads -> still zero, no stale
+    blob = json.loads(dump_baseline([]))
+    assert blob == {"version": 1, "entries": []}
+    p = tmp_path / "lint-baseline.json"
+    p.write_text(dump_baseline([]), encoding="utf-8")
+    from fishnet_tpu.lint import load_baseline
+
+    assert load_baseline(p) == []
+    project = make_project(
+        tmp_path, {"fishnet_tpu/ops/clean.py": "X = 1\n"})
+    result = run_lint(project, baseline=load_baseline(p))
+    assert not result.failed and result.stale_baseline == []
+
+
+# --------------------------------------------------- CLI: changed/explain
+
+
+def _git(tmp_path, *args):
+    subprocess.run(
+        ["git", *args], cwd=tmp_path, check=True, capture_output=True,
+        env={"HOME": str(tmp_path), "GIT_AUTHOR_NAME": "t",
+             "GIT_AUTHOR_EMAIL": "t@t", "GIT_COMMITTER_NAME": "t",
+             "GIT_COMMITTER_EMAIL": "t@t", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+
+
+def test_cli_changed_scopes_report_to_dirty_files(tmp_path):
+    from fishnet_tpu.lint.__main__ import main
+
+    make_project(tmp_path, {
+        "fishnet_tpu/serve/old.py": "def f(q):\n    return q.get()\n",
+        "fishnet_tpu/serve/new.py": "X = 1\n",
+    })
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # the committed finding exists but is out of diff scope
+    assert main(["--root", str(tmp_path), "--changed"]) == 0
+    # dirty the clean file with a finding: now in scope, gate fails
+    (tmp_path / "fishnet_tpu/serve/new.py").write_text(
+        "def g(q):\n    return q.get()\n", encoding="utf-8")
+    assert main(["--root", str(tmp_path), "--changed"]) == 1
+    # an untracked new file is in scope too
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "more")
+    (tmp_path / "fishnet_tpu/serve/born.py").write_text(
+        "def h(q):\n    return q.get()\n", encoding="utf-8")
+    assert main(["--root", str(tmp_path), "--changed"]) == 1
+
+
+def test_cli_changed_outside_git_errors(tmp_path):
+    from fishnet_tpu.lint.__main__ import main
+
+    make_project(tmp_path, {"fishnet_tpu/client/x.py": "X = 1\n"})
+    assert main(["--root", str(tmp_path), "--changed"]) == 2
+
+
+def test_cli_explain_rule_and_family(capsys):
+    from fishnet_tpu.lint.__main__ import main
+
+    assert main(["--explain", "jit-donate-use-after"]) == 0
+    out = capsys.readouterr().out
+    assert "jit-donate-use-after" in out and "donated" in out
+
+    assert main(["--explain", "dataflow"]) == 0
+    out = capsys.readouterr().out
+    assert "jit-donate-use-after" in out  # whole family section
+
+    assert main(["--explain", "not-a-rule"]) == 2
+
+
+def test_lint_report_sarif(tmp_path):
+    import tools.lint_report as lint_report
+
+    make_project(tmp_path, {
+        "fishnet_tpu/client/queue.py": "def f(q):\n    return q.get()\n"})
+    out = tmp_path / "out.sarif"
+    rc = lint_report.main(
+        ["--root", str(tmp_path), "--sarif", str(out)])
+    assert rc == 1
+    doc = json.loads(out.read_text(encoding="utf-8"))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fishnet-lint"
+    res = run["results"]
+    assert res and res[0]["ruleId"] == "conc-no-timeout"
+    loc = res[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "fishnet_tpu/client/queue.py"
+    assert loc["region"]["startLine"] == 2

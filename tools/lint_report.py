@@ -3,6 +3,8 @@ per-family counts, or GitHub workflow annotations.
 
     python -m tools.lint_report                 # grouped summary
     python -m tools.lint_report --format=github # ::error annotations
+    python -m tools.lint_report --sarif out.sarif  # SARIF 2.1.0 for code
+                                                   # scanning uploads
     python -m tools.lint_report --all           # include baselined findings
 
 Exit code mirrors `python -m fishnet_tpu.lint`: 1 when active findings
@@ -14,6 +16,7 @@ fires 30 times locally you want the grouping, not the scroll.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from collections import defaultdict
 from pathlib import Path
@@ -26,6 +29,55 @@ from fishnet_tpu.lint import Project, load_baseline, run_lint  # noqa: E402
 from fishnet_tpu.lint.__main__ import DEFAULT_BASELINE  # noqa: E402
 
 
+def _sarif(findings) -> dict:
+    """Minimal SARIF 2.1.0 document: one run, one rule object per
+    distinct rule id, one result per finding. Columns are 0-based in
+    Finding and 1-based in SARIF."""
+    rules = {}
+    results = []
+    for f in findings:
+        rules.setdefault(f.rule, {
+            "id": f.rule,
+            "helpUri": "https://github.com/fishnet-tpu/fishnet-tpu/"
+                       "blob/main/docs/lint.md",
+        })
+        results.append({
+            "ruleId": f.rule,
+            "level": "note" if f.baselined else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": int(f.line),
+                        "startColumn": int(f.col) + 1,
+                    },
+                },
+            }],
+        })
+    return {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "fishnet-lint",
+                    "informationUri": "https://github.com/fishnet-tpu/"
+                                      "fishnet-tpu/blob/main/docs/lint.md",
+                    "rules": sorted(rules.values(),
+                                    key=lambda r: r["id"]),
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.lint_report",
@@ -36,6 +88,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         default="text")
     parser.add_argument("--all", action="store_true",
                         help="include baselined findings in the report")
+    parser.add_argument("--sarif", type=Path, default=None, metavar="PATH",
+                        help="also write the shown findings as SARIF 2.1.0 "
+                             "(use '-' for stdout)")
     args = parser.parse_args(argv)
 
     root = args.root.resolve()
@@ -52,6 +107,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     result = run_lint(project, baseline=baseline)
 
     shown = result.findings if args.all else result.active
+
+    if args.sarif is not None:
+        doc = _sarif(shown)
+        blob = json.dumps(doc, indent=2, sort_keys=True)
+        if str(args.sarif) == "-":
+            print(blob)
+        else:
+            args.sarif.write_text(blob + "\n", encoding="utf-8")
+            print(f"lint_report: wrote {len(shown)} results to "
+                  f"{args.sarif}", file=sys.stderr)
 
     if args.format == "github":
         for f in shown:
