@@ -1453,6 +1453,166 @@ def run_cache_zipf_stage(timeout: float) -> dict | None:
         return None
 
 
+def mesh_scaling_child(ndev: int) -> None:
+    """Child: the FULL multipv workload (229 root-move boards of the
+    standard 8-FEN set) streamed through one registry-driven engine on
+    an `ndev`-device mesh at width 8*ndev — the pod-slice shape where
+    one logical engine's lane count grows with its device count.
+
+    Prints one RESULT line. positions_per_kstep (positions retired per
+    1000 per-shard device steps) is the hardware-independent scaling
+    metric: on a real pod each shard is a chip and wall-clock tracks
+    per-shard steps, while on a forced-device CPU host all shards
+    time-share one core, so wall positions/s (also reported) cannot show
+    device parallelism. Mean live occupancy per shard comes straight
+    from the stream's boundary summaries."""
+    # must land before the first jax import in this process
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    t0 = time.monotonic()
+    import numpy as np
+
+    import jax  # noqa: F401  (device init under the forced flag)
+    from fishnet_tpu.models import nnue
+    from fishnet_tpu.ops import search as S
+    from fishnet_tpu.parallel.mesh import make_mesh, make_sharded_table
+
+    _hb(t0, f"mesh_scaling ndev={ndev}: building workload")
+    width = 8 * ndev
+    roots, n_all = _all_boards_for(width, "standard", "multipv")
+    # first 96 root-move boards: > width at every ndev (so refill fires
+    # everywhere), small enough that the width-8 run — ~12 serial fill
+    # generations on one core — fits the stage budget
+    n_pos = min(96, n_all)
+    roots = jax.tree_util.tree_map(lambda a: a[:n_pos], roots)
+    # depth 1, staggered node budgets: 96 distinct root-move boards
+    # park at different boundaries on different shards (different move
+    # counts, different budgets), so refill and the finished-lane
+    # gathers interleave — deeper lanes would push the width-8 run to
+    # many minutes on a 1-core host without changing the scaling story
+    depths = np.ones(n_pos, np.int32)
+    budget = np.asarray(
+        [1_500 + 250 * (i % 7) for i in range(n_pos)], np.int32)
+    params = nnue.init_params(
+        jax.random.PRNGKey(3), l1=32, feature_set="board768")
+    mesh = make_mesh(ndev)
+    kw = dict(max_ply=6, width=width, segment_steps=30, mesh=mesh,
+              pipeline=True)
+
+    # warmup: the SAME shapes (compilation is shape-keyed) at a budget
+    # low enough to drain in seconds — still deep enough to fire refill
+    # and the finished-lane gathers, so every program is warm before
+    # the timed pass
+    _hb(t0, f"exec_start warmup stream (width={width}, N={n_pos})")
+    S.search_stream(params, roots, depths,
+                    np.full(n_pos, 200, np.int32),
+                    tt=make_sharded_table(mesh, 10), **kw)
+    _hb(t0, "exec_start timed stream")
+    t1 = time.perf_counter()
+    out = S.search_stream(params, roots, depths, budget,
+                          tt=make_sharded_table(mesh, 10), **kw)
+    dt = time.perf_counter() - t1
+    _hb(t0, f"exec_done timed: {dt:.2f}s")
+
+    done = int(np.asarray(out["done"]).sum())
+    steps = int(np.asarray(out["steps"]))  # per-shard device steps
+    occ = out["occupancy"]
+    lane_steps = sum(r["live"] * r["steps"] for r in occ)
+    denom = max(sum(width * r["steps"] for r in occ), 1)
+    local = width // ndev
+    shard_occ = [
+        round(sum(r["shard_live"][s] * r["steps"] for r in occ)
+              / max(sum(local * r["steps"] for r in occ), 1), 3)
+        for s in range(ndev)
+    ]
+    print(
+        "RESULT "
+        + json.dumps({
+            "ndev": ndev,
+            "width": width,
+            "positions": n_pos,
+            "done": done,
+            "dt": round(dt, 2),
+            "positions_per_s": round(n_pos / dt, 2),
+            "steps_per_shard": steps,
+            "positions_per_kstep": round(n_pos / max(steps, 1) * 1000, 2),
+            "mean_live_occupancy": round(lane_steps / denom, 3),
+            "shard_live_occupancy": shard_occ,
+            "refills": int(out["refills"]),
+            "boundaries": len(occ),
+        }),
+        flush=True,
+    )
+
+
+def run_mesh_scaling_stage(timeout: float) -> dict | None:
+    """Mesh scaling row (partition-rule registry): the SAME multipv
+    workload through one registry-derived sharded engine at ndev =
+    1/2/4/8 virtual devices, width 8*ndev. scaling_x is the
+    positions-per-shard-step ratio vs ndev=1 — the wall-clock scaling a
+    real pod slice sees, measured on CPU where the shards time-share
+    one core (wall positions/s rides along per row for reference).
+
+    Knobs: BENCH_MESH_SCALING=0 skips; BENCH_MESH_SCALING_NDEV
+    (default "1,2,4,8")."""
+    import tempfile
+
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_MESH_SCALING_NDEV", "1,2,4,8").split(",")]
+    here = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.monotonic()
+    rows: dict = {}
+    base_ppk = None
+    for ndev in counts:
+        remaining = timeout - (time.monotonic() - t0)
+        if remaining < 60.0:
+            print(f"bench mesh_scaling: skipping ndev={ndev} "
+                  "(stage budget spent)", file=sys.stderr, flush=True)
+            break
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        with tempfile.NamedTemporaryFile("w+", suffix=".bench-hb") as hb:
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--mesh-scaling-stage", str(ndev)],
+                    stdout=subprocess.PIPE, stderr=hb, text=True,
+                    timeout=remaining, env=env, cwd=here,
+                )
+            except subprocess.TimeoutExpired:
+                hb.seek(0)
+                tail = hb.read()[-2000:]
+                print(f"bench mesh_scaling: ndev={ndev} TIMED OUT; "
+                      f"heartbeat tail:\n{tail}",
+                      file=sys.stderr, flush=True)
+                break  # keep the rows already measured
+        if r.returncode != 0:
+            print(f"bench mesh_scaling: ndev={ndev} rc={r.returncode}",
+                  file=sys.stderr, flush=True)
+            break
+        row = None
+        for line in r.stdout.splitlines():
+            if line.startswith("RESULT "):
+                row = json.loads(line[len("RESULT "):])
+        if row is None:
+            print(f"bench mesh_scaling: ndev={ndev}: no RESULT line",
+                  file=sys.stderr, flush=True)
+            break
+        if row["done"] != row["positions"]:
+            print(f"bench mesh_scaling: ndev={ndev} left "
+                  f"{row['positions'] - row['done']} unfinished",
+                  file=sys.stderr, flush=True)
+            break
+        if base_ppk is None:
+            base_ppk = row["positions_per_kstep"]
+        row["scaling_x"] = round(
+            row["positions_per_kstep"] / max(base_ppk, 1e-9), 2)
+        rows[str(ndev)] = row
+    if not rows:
+        return None
+    return {"ndev": rows}
+
+
 def run_coldstart_stage(timeout: float) -> dict | None:
     """Cold-start A/B row (AOT program assets, fishnet_tpu/aot/):
     time-to-first-result of a FRESH engine process, plain JIT vs booted
@@ -1830,6 +1990,23 @@ def main() -> None:
                   + (json.dumps(res) if res else "FAILED"),
                   file=sys.stderr, flush=True)
 
+    # mesh scaling row (partition-rule registry): one registry-driven
+    # engine over 1/2/4/8 virtual devices at width 8*ndev, same multipv
+    # workload — positions-per-shard-step scaling is the pod-slice
+    # story next to fleet_scaling's many-engines story
+    if os.environ.get("BENCH_MESH_SCALING", "1") != "0":
+        remaining = total_budget - (time.monotonic() - t_start)
+        if remaining < 120.0:
+            print("bench: skipping mesh_scaling (budget spent)",
+                  file=sys.stderr, flush=True)
+            matrix["mesh_scaling"] = None
+        else:
+            res = run_mesh_scaling_stage(min(stage_timeout * 2, remaining))
+            matrix["mesh_scaling"] = res
+            print("bench config mesh_scaling: "
+                  + (json.dumps(res) if res else "FAILED"),
+                  file=sys.stderr, flush=True)
+
     # cold-start A/B row (AOT program assets, round 13): time-to-first-
     # result of a fresh engine subprocess, plain JIT vs a pre-packed
     # bundle. Opt-in (BENCH_COLDSTART=1) — the pack leg recompiles the
@@ -1914,5 +2091,7 @@ if __name__ == "__main__":
             int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
             *(sys.argv[5:7] or ()),
         )
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--mesh-scaling-stage":
+        mesh_scaling_child(int(sys.argv[2]))
     else:
         main()

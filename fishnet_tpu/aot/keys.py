@@ -54,12 +54,20 @@ def _jaxlib_version() -> str:
 
 
 def store_fingerprint() -> Dict[str, Any]:
-    """The compatibility envelope of this process's compiled programs."""
+    """The compatibility envelope of this process's compiled programs.
+
+    Includes the mesh topology (shape, axis names, process count from
+    parallel.partition.default_topology): a sharded executable bakes its
+    mesh into the compiled program, so a bundle packed on a 1-host mesh
+    must be rejected-with-named-diff on a 2-host mesh — loading it would
+    deserialize garbage (or deadlock the pod) at dispatch time."""
     try:
         devs = jax.devices()
     except Exception:
         devs = []
-    return {
+    from ..parallel.partition import default_topology
+
+    fp: Dict[str, Any] = {
         "jax": jax.__version__,
         "jaxlib": _jaxlib_version(),
         "backend": jax.default_backend(),
@@ -69,6 +77,8 @@ def store_fingerprint() -> Dict[str, Any]:
             name: settings.raw(name) or "" for name in AOT_KEY_SETTINGS
         },
     }
+    fp.update(default_topology())
+    return fp
 
 
 def fingerprint_digest(fp: Dict[str, Any]) -> str:

@@ -168,12 +168,17 @@ def main(argv=None) -> int:
         log(f"engine construction/warmup failed: {type(e).__name__}: {e}")
         return 1
     # the ready frame carries the AOT boot report so the supervisor can
-    # log (and the fleet surface) whether this replica booted warm
+    # log (and the fleet surface) whether this replica booted warm, plus
+    # the mesh topology (parallel/partition.py) so a pod: fleet member's
+    # health surfaces how many devices/processes its one logical engine
+    # actually spans
     from ..aot import registry as aot_registry
+    from ..parallel.partition import default_topology
 
     send({
         "t": "ready", "mono": time.monotonic(),
         "aot": aot_registry.boot_report(),
+        "mesh": default_topology(),
     })
     phases.enter("idle")
 

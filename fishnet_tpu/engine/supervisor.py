@@ -230,6 +230,7 @@ class SupervisedEngine(ChunkSubmit):
         # last ready frame's AOT boot report (engine/host.py): did this
         # child boot warm from a program bundle, and what does it cover
         self.aot_report: Optional[dict] = None
+        self.mesh_report: Optional[dict] = None  # host mesh topology
         self._down_noted = True  # no live child yet
         self._closing = False
         self._go_id = 0
@@ -804,6 +805,11 @@ class SupervisedEngine(ChunkSubmit):
                     if isinstance(mono, (int, float)):
                         # config-time estimate: first usable offset
                         self._clock.sample(float(mono), self._last_frame)
+                    mesh_rep = msg.get("mesh")
+                    if isinstance(mesh_rep, dict):
+                        # pod members span devices on several processes;
+                        # surface the topology next to the AOT report
+                        self.mesh_report = mesh_rep
                     rep = msg.get("aot")
                     if isinstance(rep, dict):
                         # surfaced into fleet member health and logs: a

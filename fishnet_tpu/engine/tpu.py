@@ -158,8 +158,13 @@ class TpuEngine(ChunkSubmit):
         # 1-D mesh and each device advances its shard independently — the
         # TPU equivalent of the reference's engine-process-per-core
         # (src/main.rs:151-161). Single-device hosts skip the mesh.
+        from ..parallel import distributed as dist_mod
         from ..parallel.mesh import make_mesh, make_sharded_table
 
+        # FISHNET_TPU_MESH_HOSTS > 1: join the jax.distributed pod
+        # BEFORE the first jax.devices() call, so the mesh below spans
+        # the global device set — one logical engine across processes
+        dist_mod.ensure_initialized(logger=logger)
         n_dev = len(jax.devices())
         self.mesh = make_mesh() if n_dev > 1 else None
         self.n_dev = n_dev if self.mesh is not None else 1
@@ -1710,6 +1715,17 @@ class LaneScheduler:
         mesh = eng.mesh
         n_shard = eng.n_dev if mesh is not None else 1
         local = B // n_shard
+        # mesh-topology-aware admission: free lists index GLOBAL shards
+        # (lane numbering spans the whole pod) but new work is admitted
+        # only into shards whose device this process can address — on a
+        # single-host mesh that is every shard, so the historical
+        # assignment is unchanged bit-for-bit
+        if mesh is not None:
+            from ..parallel import distributed as _dist
+
+            fillable_shards = set(_dist.addressable_shards(mesh))
+        else:
+            fillable_shards = {0}
         seg = settings.get_segment()
         ctrl = None
         if seg is None:  # FISHNET_TPU_SEGMENT=auto
@@ -2126,7 +2142,8 @@ class LaneScheduler:
             free_by_shard: List[List[int]] = [[] for _ in range(n_shard)]
             for i in range(B):
                 if lane_job[i] is None and lane_owner[i] is None:
-                    free_by_shard[i // local].append(i)
+                    if (i // local) in fillable_shards:
+                        free_by_shard[i // local].append(i)
             n_free = sum(len(f) for f in free_by_shard)
 
             def take_lane() -> int:

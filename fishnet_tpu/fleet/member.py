@@ -12,6 +12,10 @@ or `--fleet-members`):
 
     local            one SupervisedEngine-managed host child here
     local*4          four of them
+    pod:2            one giant-B member spanning a 2-process
+                     jax.distributed mesh (process 0 is the host child
+                     here; workers join per the docs/mesh.md runbook)
+    pod:2@h:1234     same, with an explicit coordinator address
     http://h:9670    a remote `fishnet-tpu serve` endpoint
     h:9670           same (bare host:port implies http)
 
@@ -126,9 +130,11 @@ class FleetMember:
         # preloaded, seconds to first dispatch) from cold ones (minutes
         # of XLA compiles) and scale accordingly
         aot = getattr(self.engine, "aot_report", None)
+        mesh = getattr(self.engine, "mesh_report", None)
         return {
             "name": self.name,
             "kind": self.kind,
+            "mesh": mesh,
             "state": self.state(now),
             "available": self.available(now),
             "backlog": self.backlog,
@@ -205,10 +211,58 @@ def make_local_member(
     return member
 
 
+# default jax.distributed coordinator for `pod:` members without an
+# explicit @host:port (the host-level boundary exchange rides one port
+# above it — parallel/distributed.py)
+_POD_DEFAULT_COORDINATOR = "127.0.0.1:9791"
+
+
+def parse_pod_spec(token: str) -> tuple:
+    """'pod:N[@host:port]' → (hosts, coordinator address).
+
+    N is the jax.distributed process count of the pod member's ONE
+    logical engine; the member's host child runs as process 0 (it hosts
+    the coordinator), workers N>0 are launched out-of-band per the
+    docs/mesh.md runbook."""
+    body = token[len("pod:"):]
+    addr = _POD_DEFAULT_COORDINATOR
+    if "@" in body:
+        body, addr = body.split("@", 1)
+    try:
+        hosts = int(body)
+    except ValueError:
+        raise ValueError(
+            f"fleet member spec {token!r}: host count after 'pod:' "
+            "must be an integer"
+        ) from None
+    if hosts < 1:
+        raise ValueError(
+            f"fleet member spec {token!r}: host count must be >= 1"
+        )
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"fleet member spec {token!r}: coordinator must be host:port"
+        )
+    return hosts, addr
+
+
+def pod_member_env(hosts: int, coordinator: str) -> Dict[str, str]:
+    """The engine-env overlay that turns a host child into pod process 0
+    (engine/tpu.py calls parallel.distributed.ensure_initialized from
+    these settings before first device use)."""
+    return {
+        "FISHNET_TPU_MESH_HOSTS": str(hosts),
+        "FISHNET_TPU_MESH_COORDINATOR": coordinator,
+        "FISHNET_TPU_MESH_PROCESS_ID": "0",
+    }
+
+
 def members_from_specs(
     spec: Optional[str] = None,
     *,
     local_factory: Optional[Callable[[str], FleetMember]] = None,
+    pod_factory: Optional[Callable[[str, Dict[str, str]], FleetMember]] = None,
     logger: Optional[Logger] = None,
 ) -> List[FleetMember]:
     """Parse the member-spec grammar into live FleetMembers.
@@ -216,21 +270,35 @@ def members_from_specs(
     `local_factory(name)` builds local members (callers close over their
     Config — app.py — or a fakehost command line — tests/chaos/bench);
     it defaults to a bare `make_local_member(name)` from registry
-    settings. Remote specs become `HttpEngine` members directly.
+    settings. `pod_factory(name, env)` builds pod members — local
+    members whose host child boots as process 0 of a multi-host mesh via
+    the given engine-env overlay (pod_member_env). Remote specs become
+    `HttpEngine` members directly.
     """
     if spec is None:
         spec = settings.get_str("FISHNET_TPU_FLEET_MEMBERS")
     log = logger or Logger()
     if local_factory is None:
         local_factory = lambda name: make_local_member(name)  # noqa: E731
+    if pod_factory is None:
+        pod_factory = (  # noqa: E731
+            lambda name, env: make_local_member(name, env=env)
+        )
     members: List[FleetMember] = []
     seen: Set[str] = set()
     locals_made = 0
+    pods_made = 0
     for raw in spec.split(","):
         token = raw.strip()
         if not token:
             continue
-        if token == "local" or token.startswith("local*"):
+        if token.startswith("pod:"):
+            hosts, coord = parse_pod_spec(token)
+            name = f"pod{pods_made}"
+            pods_made += 1
+            member = pod_factory(name, pod_member_env(hosts, coord))
+            members.append(member)
+        elif token == "local" or token.startswith("local*"):
             count = 1
             if "*" in token:
                 try:

@@ -148,6 +148,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from . import concurrency_rules  # noqa: F401
         from . import config_rules  # noqa: F401
         from . import dataflow_rules  # noqa: F401
+        from . import mesh_rules  # noqa: F401
         from . import obs_rules  # noqa: F401
         from . import trace_rules  # noqa: F401
         from . import wire_rules  # noqa: F401

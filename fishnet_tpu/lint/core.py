@@ -188,6 +188,7 @@ def run_lint(
     from . import concurrency_rules  # noqa: F401
     from . import config_rules  # noqa: F401
     from . import dataflow_rules  # noqa: F401
+    from . import mesh_rules  # noqa: F401
     from . import obs_rules  # noqa: F401
     from . import trace_rules  # noqa: F401
     from . import wire_rules  # noqa: F401

@@ -1,0 +1,121 @@
+"""Mesh-sharding discipline rules.
+
+The partition-rule registry (parallel/partition.py) is the ONE place
+layout decisions live: every shard_map in/out spec, NamedSharding and
+PartitionSpec the engine uses derives from its rule table, so
+single-host, forced-multi-device and multi-host jax.distributed meshes
+stay one data-driven code path. A hand-built spec anywhere else is a
+layout decision the registry cannot see — it drifts silently when a
+state field is added or an axis is renamed, and on a multi-host mesh a
+divergent spec deadlocks or corrupts instead of failing loudly.
+
+Rules:
+  mesh-unregistered-spec   any call that resolves to
+                           jax.sharding.PartitionSpec / NamedSharding
+                           (any import-alias form, including
+                           `from jax.sharding import PartitionSpec as P`)
+                           or to shard_map (jax.shard_map or
+                           jax.experimental.shard_map.shard_map) — in
+                           any package/tool file other than
+                           parallel/partition.py and parallel/mesh.py.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project, SourceFile, dotted, register_family
+
+# the two files allowed to construct sharding specs directly
+_ALLOWED = (
+    "fishnet_tpu/parallel/partition.py",
+    "fishnet_tpu/parallel/mesh.py",
+)
+
+_SHARDING_MODULE = "jax.sharding"
+_SPEC_NAMES = {"PartitionSpec", "NamedSharding"}
+_SHARD_MAP_MODULE = "jax.experimental.shard_map"
+
+
+def _spec_call_sites(src: SourceFile) -> List[ast.Call]:
+    """Every call in this file that resolves to a sharding-spec
+    constructor (PartitionSpec/NamedSharding through any import form of
+    jax.sharding) or to shard_map (jax.shard_map attribute access, or
+    any import form of jax.experimental.shard_map.shard_map)."""
+    shard_mod_aliases: Set[str] = set()  # alias -> jax.sharding module
+    sm_mod_aliases: Set[str] = set()     # alias -> ...shard_map module
+    jax_aliases: Set[str] = set()        # alias -> jax itself
+    bare_specs: Set[str] = set()         # from-imported spec constructors
+    bare_shard_map: Set[str] = set()     # from-imported shard_map fn
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _SHARDING_MODULE:
+                    shard_mod_aliases.add(alias.asname or alias.name)
+                elif alias.name == _SHARD_MAP_MODULE:
+                    sm_mod_aliases.add(alias.asname or alias.name)
+                elif alias.name == "jax":
+                    jax_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                continue
+            if node.module == _SHARDING_MODULE:
+                for alias in node.names:
+                    if alias.name in _SPEC_NAMES:
+                        bare_specs.add(alias.asname or alias.name)
+            elif node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "sharding":
+                        shard_mod_aliases.add(alias.asname or alias.name)
+                    elif alias.name == "shard_map":
+                        bare_shard_map.add(alias.asname or alias.name)
+            elif node.module == "jax.experimental":
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        sm_mod_aliases.add(alias.asname or alias.name)
+            elif node.module == _SHARD_MAP_MODULE:
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        bare_shard_map.add(alias.asname or alias.name)
+
+    sites: List[ast.Call] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if not name:
+            continue
+        head, _, tail = name.rpartition(".")
+        if name in bare_specs or name in bare_shard_map:
+            sites.append(node)
+        elif head in shard_mod_aliases and tail in _SPEC_NAMES:
+            sites.append(node)
+        elif head in sm_mod_aliases and tail == "shard_map":
+            sites.append(node)
+        elif head in jax_aliases and tail == "shard_map":
+            sites.append(node)  # jax.shard_map (new-style alias)
+        elif (head.split(".", 1)[0] in jax_aliases
+              and name.endswith(".sharding." + tail)
+              and tail in _SPEC_NAMES):
+            sites.append(node)  # jax.sharding.PartitionSpec(...)
+    return sites
+
+
+@register_family("mesh")
+def check_mesh_registered_specs(project: Project) -> List[Finding]:
+    """Sharding specs stay behind the partition-rule registry."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu", "tools", "bench.py"):
+        if src.rel in _ALLOWED:
+            continue
+        for node in _spec_call_sites(src):
+            findings.append(src.finding(
+                "mesh-unregistered-spec", node,
+                "hand-built sharding spec outside parallel/partition.py "
+                "+ parallel/mesh.py — a layout decision the partition-"
+                "rule registry cannot see, which drifts silently when "
+                "state fields or mesh topology change; derive it from "
+                "the registry (match_partition_rules / segment_specs / "
+                "named_sharding in fishnet_tpu/parallel/partition.py)",
+            ))
+    return findings

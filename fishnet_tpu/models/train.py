@@ -17,9 +17,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops.board import piece_color, piece_type  # noqa: F401 (re-export context)
+from ..parallel import partition as _partition
 from . import nnue
 
 
@@ -55,24 +56,23 @@ def make_train_step(optimizer):
 
 
 def param_shardings(mesh: Mesh) -> nnue.NnueParams:
-    """TP over the feature-transform width; the small stack is replicated."""
-    return nnue.NnueParams(
-        ft_w=NamedSharding(mesh, P(None, "tp")),
-        ft_b=NamedSharding(mesh, P("tp")),
-        l1_w=NamedSharding(mesh, P()),
-        l1_b=NamedSharding(mesh, P()),
-        l2_w=NamedSharding(mesh, P()),
-        l2_b=NamedSharding(mesh, P()),
-        out_w=NamedSharding(mesh, P()),
-        out_b=NamedSharding(mesh, P()),
+    """TP over the feature-transform width; the small stack is
+    replicated. Derived from the partition-rule registry
+    (parallel/partition.py PARAM_RULES_TP) — the training layout and the
+    search engine's replicated layout live in ONE table."""
+    return jax.tree_util.tree_map(
+        lambda spec: _partition.named_sharding(mesh, spec),
+        _partition.param_specs(tp=True),
     )
 
 
 def make_sharded_train_step(mesh: Mesh, optimizer):
     """Training step with dp×tp shardings; collectives inserted by XLA."""
     p_shard = param_shardings(mesh)
-    batch_shard = NamedSharding(mesh, P("dp"))
-    board_shard = NamedSharding(mesh, P("dp", None))
+    batch_shard = _partition.named_sharding(
+        mesh, _partition.batch_spec(1))
+    board_shard = _partition.named_sharding(
+        mesh, _partition.batch_spec(2))
 
     @partial(
         jax.jit,

@@ -1302,7 +1302,9 @@ def search_stream(
         group=jnp.zeros((width,), jnp.int32),
     )
     ndev = local = 1
+    multiproc = False
     if mesh is not None:
+        from ..parallel import distributed as _dist
         from ..parallel.mesh import (
             refill_lanes_sharded,
             run_segment_sharded,
@@ -1314,6 +1316,18 @@ def search_stream(
             raise ValueError(
                 f"stream width {width} must divide over {ndev} devices")
         local = width // ndev
+        multiproc = _dist.spans_processes(mesh)
+        if multiproc:
+            # multi-host stream: every participating process drives this
+            # same loop with identical inputs (SPMD discipline); only
+            # the pipelined loop's host fetches are addressable-shard
+            # aware (parallel/distributed.py), the synchronous loop
+            # materializes full sharded arrays and cannot be
+            if not pipeline:
+                raise ValueError(
+                    "a multi-host mesh requires the pipelined stream "
+                    "loop (FISHNET_TPU_PIPELINE=1)")
+            params = _dist.replicate_tree(mesh, params)
         # place the fresh state sharded BEFORE the first dispatch: the
         # sharded segment donates its operands, and donation only takes
         # when the input already carries the program's sharding
@@ -1406,12 +1420,35 @@ def search_stream(
 
     def pull_pv(st, lanes, pos):
         """Materialize PV rows for finished lanes only: two small
-        device-side gathers instead of the full (B, P) table."""
+        device-side gathers instead of the full (B, P) table. On a
+        multi-host mesh each process gathers the rows its addressable
+        shards own and the host exchange fills in the rest, so every
+        process assembles identical results."""
+        if multiproc:
+            from ..parallel import distributed as _dist
+
+            out["pv"][pos] = _dist.gather_rows(
+                mesh, st.pv, lanes, stats, "pv",
+                pick=lambda a: a[:, 0], tail=(P,), dtype=np.int32)
+            out["pv_len"][pos] = _dist.gather_rows(
+                mesh, st.nt, lanes, stats, "pv_len",
+                pick=lambda a: a[:, 0, NT_PVLEN], tail=(),
+                dtype=np.int32)
+            return
         rows = jnp.asarray(np.asarray(lanes, np.int64))
         out["pv"][pos] = stats.fetch(
             jnp.take(st.pv[:, 0], rows, axis=0), "pv")
         out["pv_len"][pos] = stats.fetch(
             jnp.take(st.nt[:, 0, NT_PVLEN], rows, axis=0), "pv_len")
+
+    def pull_summ(p_summ):
+        """One boundary summary fetch; addressable-shard aware when the
+        mesh spans processes (ONE local fetch + host exchange)."""
+        if multiproc:
+            from ..parallel import distributed as _dist
+
+            return _dist.fetch_summary(mesh, p_summ, stats, "summary")
+        return stats.fetch(p_summ, "summary")
 
     def record(n, live, n_ref, pend_steps, shard=None):
         nonlocal seg_i, segment_steps
@@ -1492,8 +1529,7 @@ def search_stream(
                 # issuing it now donates p_state/p_tt in place and keeps
                 # the device busy across the host's boundary work
                 nxt = dispatch(p_state, p_tt, nxt_steps)
-            summ, n, shard_steps = canon_summ(
-                stats.fetch(p_summ, "summary"))
+            summ, n, shard_steps = canon_summ(pull_summ(p_summ))
             total += n
             lane_done = summ[:, SUM_DONE].astype(bool)
             fin = np.nonzero(lane_done & (lane_pos >= 0))[0]

@@ -6,6 +6,13 @@ stealing. Here the within-host axis is a `jax.sharding.Mesh`: search lanes
 are embarrassingly parallel, so the batch dimension shards over all chips
 ("dp"), with NNUE weights replicated in every chip's HBM — collectives only
 appear in training (psum of grads over dp, all_gather over tp).
+
+Every in/out spec below derives from the partition-rule registry
+(parallel/partition.py) rather than hand-built literals, so a single-host
+shard_map, a forced-multi-device CPU mesh and a multi-host
+jax.distributed mesh (parallel/distributed.py builds that one) are ONE
+data-driven code path; fishnet-lint's mesh-unregistered-spec rule keeps
+it that way.
 """
 from __future__ import annotations
 
@@ -14,10 +21,11 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..aot import registry as _aot_registry
 from ..utils import sanitize as _sanitize
+from . import partition as _partition
 
 try:
     _shard_map = jax.shard_map
@@ -41,18 +49,28 @@ def make_2d_mesh(dp: int, tp: int) -> Mesh:
 
 
 def shard_batch(mesh: Mesh, tree, axis: str = "dp"):
-    """Place a pytree of batched arrays with the leading dim sharded."""
+    """Place a pytree of batched arrays with the leading dim sharded.
+
+    Routed through distributed.put_global so the same call works when
+    the mesh spans jax.distributed processes (each host contributes its
+    addressable shards from identical host-side values)."""
+    from . import distributed as _distributed
 
     def put(x):
-        spec = P(axis, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _distributed.put_global(
+            mesh, x, _partition.batch_spec(getattr(x, "ndim", 1), axis)
+        )
 
     return jax.tree_util.tree_map(put, tree)
 
 
 def replicate(mesh: Mesh, tree):
+    from . import distributed as _distributed
+
     def put(x):
-        return jax.device_put(x, NamedSharding(mesh, P()))
+        return _distributed.put_global(
+            mesh, x, _partition.replicated_spec()
+        )
 
     return jax.tree_util.tree_map(put, tree)
 
@@ -86,12 +104,12 @@ def _segment_callable(mesh: Mesh, axis: str, has_tt: bool,
             ttab = jax.tree.map(lambda a: a[None], ttab)
         return state, ttab, n.reshape(1), summ[None]
 
+    in_specs, out_specs = _partition.segment_specs(has_tt, axis)
     fn = _shard_map(
         seg,
         mesh=mesh,
-        in_specs=(P(), P(axis), P(axis) if has_tt else P(), P(), P(axis)),
-        out_specs=(P(axis), P(axis) if has_tt else P(), P(axis),
-                   P(axis, None, None)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         **_SHARD_MAP_KW,
     )
     # AOT-wrapped (fishnet_tpu/aot/): the shard_map closure's compile
@@ -131,6 +149,8 @@ def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
     tt_gen may be a scalar or a per-lane (B,) array."""
     import jax.numpy as jnp
 
+    from . import distributed as _distributed
+
     fn = _segment_callable(
         mesh, axis, ttab is not None, variant, deep_tt, prefer_deep,
     )
@@ -138,7 +158,16 @@ def run_segment_sharded(mesh: Mesh, params, state, ttab, segment_steps: int,
     gen = jnp.asarray(tt_gen, jnp.int32)
     if gen.ndim == 0:
         gen = jnp.full((B,), gen, jnp.int32)
-    return fn(params, state, ttab, jnp.int32(segment_steps), gen)
+    steps = jnp.int32(segment_steps)
+    if _distributed.spans_processes(mesh):
+        # host-local scalars/arrays must be promoted to global arrays
+        # before a multi-host dispatch (every process holds identical
+        # values, so this is pure placement, no communication)
+        gen = _distributed.put_global(
+            mesh, gen, _partition.spec_for("tt_gen", axis))
+        steps = _distributed.put_global(
+            mesh, steps, _partition.spec_for("segment_steps", axis))
+    return fn(params, state, ttab, steps, gen)
 
 
 @functools.lru_cache(maxsize=None)
@@ -150,11 +179,12 @@ def _merge_callable(mesh: Mesh, axis: str):
     are donated (the merge rebinds, never copies)."""
     from ..ops.search import _merge_lanes
 
+    in_specs, out_specs = _partition.merge_specs(axis)
     fn = _shard_map(
         _merge_lanes,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=P(axis),
+        in_specs=in_specs,
+        out_specs=out_specs,
         **_SHARD_MAP_KW,
     )
     return _sanitize.guard_donation(

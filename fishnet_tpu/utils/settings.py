@@ -106,6 +106,39 @@ SETTINGS: Tuple[Setting, ...] = (
         engine=True,
     ),
     Setting(
+        name="FISHNET_TPU_MESH_HOSTS",
+        kind="int",
+        default="1",
+        doc="Number of jax.distributed processes forming ONE logical "
+            "engine over a multi-host mesh (parallel/distributed.py). "
+            "1 (default) keeps the single-process mesh path; > 1 makes "
+            "the engine call jax.distributed.initialize before first "
+            "device use and build its mesh over the GLOBAL device set. "
+            "Requires FISHNET_TPU_MESH_COORDINATOR; see docs/mesh.md.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_MESH_COORDINATOR",
+        kind="str",
+        default="",
+        doc="host:port of the jax.distributed coordinator (process 0) "
+            "when FISHNET_TPU_MESH_HOSTS > 1. The host-level boundary "
+            "exchange (parallel/distributed.py HostExchange) rides one "
+            "port above this.",
+        engine=True,
+    ),
+    Setting(
+        name="FISHNET_TPU_MESH_PROCESS_ID",
+        kind="int",
+        default="0",
+        doc="This process's id in [0, FISHNET_TPU_MESH_HOSTS) for "
+            "jax.distributed.initialize. Process 0 hosts the "
+            "coordinator and (in a pod: fleet member) sits inside the "
+            "fleet coordinator; workers run the same dispatch sequence "
+            "(docs/mesh.md runbook).",
+        engine=True,
+    ),
+    Setting(
         name="FISHNET_TPU_NARROW_FLOOR",
         kind="int",
         default="64",

@@ -96,6 +96,39 @@ def test_fingerprint_skew_is_named_field_by_field():
     assert len(diff) == 2
 
 
+def test_fingerprint_folds_mesh_topology():
+    fp = keys.store_fingerprint()
+    # topology fields present and coherent with the live process
+    assert fp["mesh_axes"] == "dp"
+    assert fp["process_count"] == jax.process_count()
+    assert fp["mesh_shape"] == str(len(jax.devices()))
+
+
+def test_topology_skewed_bundle_rejected_with_fields_named(aot_root):
+    """A bundle packed on a different pod topology must be rejected with
+    mesh_shape / process_count named — a sharded executable bakes its
+    mesh in, and loading it cross-topology deserializes garbage."""
+    theirs = json.loads(json.dumps(keys.store_fingerprint()))
+    theirs["mesh_shape"] = "16x2"
+    theirs["process_count"] = 4
+    diff = keys.diff_fingerprints(keys.store_fingerprint(), theirs)
+    assert any(d.startswith("mesh_shape:") for d in diff)
+    assert any(d.startswith("process_count:") for d in diff)
+    assert len(diff) == 2
+
+    other = os.path.join(aot_root, keys.fingerprint_digest(theirs)[:12])
+    os.makedirs(other)
+    with open(os.path.join(other, "manifest.json"), "w") as f:
+        json.dump({"version": registry.MANIFEST_VERSION,
+                   "fingerprint": theirs, "programs": {"k": {}},
+                   "covers": []}, f)
+    warnings = []
+    reg = registry.install(aot_root, logger=warnings.append)
+    assert not reg.active
+    assert any("incompatible" in w and "mesh_shape" in w
+               and "process_count" in w for w in warnings)
+
+
 def test_program_key_canonicalizes_statics_and_avals():
     x = jnp.arange(4, dtype=jnp.float32)
     k1, meta = keys.program_key("p", {"s": 1}, None, (x,))

@@ -118,6 +118,50 @@ def test_member_spec_grammar():
         members_from_specs("h:1,h:1", logger=Logger(verbose=0))
 
 
+def test_pod_spec_parse_round_trip():
+    from fishnet_tpu.fleet.member import parse_pod_spec, pod_member_env
+
+    assert parse_pod_spec("pod:2") == (2, "127.0.0.1:9791")
+    assert parse_pod_spec("pod:4@10.0.0.5:7000") == (4, "10.0.0.5:7000")
+    for bad in ("pod:x", "pod:0", "pod:-1", "pod:2@nohost",
+                "pod:2@:7000", "pod:2@h:"):
+        with pytest.raises(ValueError):
+            parse_pod_spec(bad)
+    # the env overlay IS the runbook contract: the host child boots as
+    # process 0 of an N-host mesh pointed at the coordinator
+    assert pod_member_env(2, "10.0.0.5:7000") == {
+        "FISHNET_TPU_MESH_HOSTS": "2",
+        "FISHNET_TPU_MESH_COORDINATOR": "10.0.0.5:7000",
+        "FISHNET_TPU_MESH_PROCESS_ID": "0",
+    }
+
+
+def test_pod_member_spec_grammar():
+    made = []
+
+    def pod_factory(name, env):
+        made.append((name, env))
+        return FleetMember(name=name, engine=object(), kind="local")
+
+    members = members_from_specs(
+        "pod:2, local, pod:3@h9:7100",
+        local_factory=lambda name: FleetMember(name=name, engine=object()),
+        pod_factory=pod_factory,
+        logger=Logger(verbose=0),
+    )
+    assert [m.name for m in members] == ["pod0", "local0", "pod1"]
+    assert made == [
+        ("pod0", {"FISHNET_TPU_MESH_HOSTS": "2",
+                  "FISHNET_TPU_MESH_COORDINATOR": "127.0.0.1:9791",
+                  "FISHNET_TPU_MESH_PROCESS_ID": "0"}),
+        ("pod1", {"FISHNET_TPU_MESH_HOSTS": "3",
+                  "FISHNET_TPU_MESH_COORDINATOR": "h9:7100",
+                  "FISHNET_TPU_MESH_PROCESS_ID": "0"}),
+    ]
+    with pytest.raises(ValueError):
+        members_from_specs("pod:zero", logger=Logger(verbose=0))
+
+
 # ------------------------------------------------------------- bit identity
 
 

@@ -1547,6 +1547,77 @@ def test_stripped_single_writer_annotation_is_caught(tmp_path):
     assert by_rule(result.findings, "conc-await-shared-mutate")
 
 
+# ------------------------------------------ mesh: unregistered specs
+
+
+MESH_BAD = '''
+import jax
+import jax.sharding as jsh
+from jax.sharding import PartitionSpec as P, NamedSharding
+from jax.experimental import shard_map as smod
+from jax.experimental.shard_map import shard_map
+
+
+def sneak(mesh, f):
+    a = P("dp")                                                # 1
+    b = jsh.PartitionSpec("dp", None)                          # 2
+    c = NamedSharding(mesh, a)                                 # 3
+    d = jax.sharding.NamedSharding(mesh, b)                    # 4
+    e = shard_map(f, mesh=mesh, in_specs=a, out_specs=b)       # 5
+    g = smod.shard_map(f, mesh=mesh, in_specs=a, out_specs=b)  # 6
+    h = jax.shard_map(f, mesh=mesh, in_specs=a, out_specs=b)   # 7
+    return a, b, c, d, e, g, h
+'''
+
+
+def test_mesh_unregistered_spec_catches_every_alias_form(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/engine/rogue.py": MESH_BAD})
+    result = run_lint(project, only_families={"mesh"})
+    found = by_rule(result.findings, "mesh-unregistered-spec")
+    assert len(found) == 7
+    assert {f.line for f in found} == set(range(10, 17))
+
+
+def test_mesh_spec_sanctioned_in_partition_and_mesh_modules(tmp_path):
+    project = make_project(tmp_path, {
+        "fishnet_tpu/parallel/partition.py": MESH_BAD,
+        "fishnet_tpu/parallel/mesh.py": MESH_BAD,
+    })
+    result = run_lint(project, only_families={"mesh"})
+    assert not result.findings
+
+
+def test_mesh_scope_covers_tools_and_bench_not_tests(tmp_path):
+    rogue = 'from jax.sharding import PartitionSpec\nS = PartitionSpec("dp")\n'
+    project = make_project(tmp_path, {
+        "tools/shardtool.py": rogue,
+        "bench.py": rogue,
+        "tests/test_whatever.py": rogue,
+    })
+    result = run_lint(project, only_families={"mesh"})
+    found = by_rule(result.findings, "mesh-unregistered-spec")
+    assert sorted(f.path for f in found) == ["bench.py",
+                                            "tools/shardtool.py"]
+
+
+def test_relocated_partition_registry_is_caught(tmp_path):
+    """Mutation test: lift the REAL registry module (which legitimately
+    builds PartitionSpec/NamedSharding) into another module — the exact
+    drift the rule exists for — and assert the lint flags the copy while
+    the sanctioned original stays clean."""
+    real = (REPO_ROOT / "fishnet_tpu/parallel/partition.py").read_text()
+    assert "NamedSharding(mesh, spec)" in real
+    project = make_project(tmp_path, {
+        "fishnet_tpu/parallel/partition.py": real,
+        "fishnet_tpu/ops/layout.py": real,
+    })
+    result = run_lint(project, only_families={"mesh"})
+    found = by_rule(result.findings, "mesh-unregistered-spec")
+    assert found and all(
+        f.path == "fishnet_tpu/ops/layout.py" for f in found)
+
+
 # ------------------------------------------------- lint-core edge cases
 
 
