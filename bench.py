@@ -455,8 +455,23 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
             jnp.int32(tt_gen),
         )
         _hb(t0, "  lowered")
-        lowered.compile()
+        compiled = lowered.compile()
     _hb(t0, "compile_done run_segment")
+    # program cost accounting (obs/perf.py): the Compiled object is
+    # already in hand, so the FLOPs/bytes/memory read is free — it
+    # rides the RESULT row into the perf ledger and the
+    # fishnet_program_* gauges
+    program_cost = {}
+    try:
+        from fishnet_tpu.obs import perf as obs_perf
+        from fishnet_tpu.utils import settings as _settings
+
+        if _settings.get_bool("FISHNET_TPU_PERF_PROGRAMS"):
+            program_cost = obs_perf.record_program_cost(
+                "run_segment", compiled)
+    except Exception as e:
+        print(f"bench: program cost capture failed: {e}",
+              file=sys.stderr, flush=True)
     # pre-compile every narrowed width down to the floor: the warmup and
     # timed runs can take DIFFERENT narrowing trajectories (a warm TT
     # changes when lanes finish), and a cold 10-40 s XLA compile landing
@@ -529,6 +544,7 @@ def stage_main(B: int, depth: int, budget: int, variant: str = "standard",
                 "net": os.environ.get("BENCH_NET", "random"),
                 "dtype": bench_dtype or "f32",
                 "tt_log2": tt_log2,
+                "program_cost": program_cost,
             }
         ),
         flush=True,
@@ -1482,8 +1498,10 @@ def mesh_scaling_child(ndev: int) -> None:
     roots, n_all = _all_boards_for(width, "standard", "multipv")
     # first 96 root-move boards: > width at every ndev (so refill fires
     # everywhere), small enough that the width-8 run — ~12 serial fill
-    # generations on one core — fits the stage budget
-    n_pos = min(96, n_all)
+    # generations on one core — fits the stage budget. The CI perf gate
+    # (BENCH_GATE) trims further: the scaling story is unchanged and the
+    # deterministic counters stay deterministic at any fixed count.
+    n_pos = min(int(os.environ.get("BENCH_MESH_SCALING_POS", "96")), n_all)
     roots = jax.tree_util.tree_map(lambda a: a[:n_pos], roots)
     # depth 1, staggered node budgets: 96 distinct root-move boards
     # park at different boundaries on different shards (different move
@@ -1724,6 +1742,85 @@ def device_preflight(timeout: float = 120.0) -> bool:
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _ledger_record(results: dict, source: str = "bench",
+                   emit: bool = False) -> None:
+    """Append one run's RESULT rows to the perf ledger (obs/perf.py)
+    and, when emit is set, write the next BENCH_rNN.json artifact from
+    it. Backfills the checked-in BENCH/MULTICHIP history first
+    (idempotent) so the trend series is populated even on a fresh
+    checkout. Never raises: a broken ledger must not cost the bench
+    run its stdout contract."""
+    try:
+        from fishnet_tpu.obs import perf as obs_perf
+    except Exception as e:
+        print(f"bench: perf ledger unavailable: {e}",
+              file=sys.stderr, flush=True)
+        return
+    try:
+        ledger = obs_perf.PerfLedger.open()
+        try:
+            ledger.backfill()
+            run_id = f"{source}-{int(time.time())}"
+            n = ledger.ingest_results(
+                run_id, results, source=source,
+                info=obs_perf.build_info(),
+            )
+            print(f"bench: perf ledger {ledger.path}: recorded {n} "
+                  f"metrics as {run_id}", file=sys.stderr, flush=True)
+            if emit and n:
+                path = ledger.emit_bench_round(run_id)
+                if path:
+                    print(f"bench: emitted {path} from the ledger",
+                          file=sys.stderr, flush=True)
+        finally:
+            ledger.close()
+    except Exception as e:
+        print(f"bench: perf ledger write failed: {e}",
+              file=sys.stderr, flush=True)
+
+
+def gate_main() -> None:
+    """CI perf-gate rows (BENCH_GATE=1): only the quick deterministic
+    counters — a toy search stage (total nodes, positions done) and a
+    1/2-device mesh-scaling pair (positions_per_kstep, steps, refills,
+    occupancy) — appended to the perf ledger under gate_* row names so
+    they build their own baseline series, never mixed with full bench
+    rows. tools/perf_report.py --check gates the counter tier against
+    the rolling baseline; wall-clock values ride along report-only
+    (docs/perf.md)."""
+    t_start = time.monotonic()
+    timeout = float(os.environ.get("BENCH_GATE_TIMEOUT", "900"))
+    results: dict = {}
+
+    res = run_stage(8, 2, 3000, timeout * 0.5, select=SELECT_FIRST,
+                    extra_env={"BENCH_SEG": "64"})
+    if res is not None:
+        results["gate_search"] = res
+    print("bench config gate_search: "
+          + (json.dumps(res) if res else "FAILED"),
+          file=sys.stderr, flush=True)
+
+    os.environ.setdefault("BENCH_MESH_SCALING_NDEV", "1,2")
+    os.environ.setdefault("BENCH_MESH_SCALING_POS", "32")
+    remaining = timeout - (time.monotonic() - t_start)
+    mesh = None
+    if remaining > 60.0:
+        mesh = run_mesh_scaling_stage(remaining)
+    if mesh is not None:
+        results["gate_mesh"] = mesh
+    print("bench config gate_mesh: "
+          + (json.dumps(mesh) if mesh else "FAILED"),
+          file=sys.stderr, flush=True)
+
+    _ledger_record(results, source="gate")
+    print(json.dumps({
+        "metric": "perf-gate deterministic rows",
+        "value": len(results),
+        "unit": "rows",
+        "vs_baseline": 1.0 if results else 0.0,
+    }))
 
 
 def main() -> None:
@@ -2066,21 +2163,26 @@ def main() -> None:
 
     cores = os.cpu_count() or 1
     baseline = 400_000 * cores  # reference NPS prior × host cores
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"batched alpha-beta+NNUE nodes/sec/chip "
-                    f"(B={best['B']}, depth={best['depth']}, "
-                    f"platform={best['platform']}, "
-                    f"row_mode={best.get('row_mode', 'scatter')}){label}"
-                ),
-                "value": round(best["nps"]),
-                "unit": "nodes/sec",
-                "vs_baseline": round(best["nps"] / baseline, 4),
-            }
-        )
-    )
+    headline = {
+        "metric": (
+            f"batched alpha-beta+NNUE nodes/sec/chip "
+            f"(B={best['B']}, depth={best['depth']}, "
+            f"platform={best['platform']}, "
+            f"row_mode={best.get('row_mode', 'scatter')}){label}"
+        ),
+        "value": round(best["nps"]),
+        "unit": "nodes/sec",
+        "vs_baseline": round(best["nps"] / baseline, 4),
+    }
+    # perf ledger (obs/perf.py, docs/perf.md): every RESULT row of this
+    # run becomes ledger history, and the next BENCH_rNN.json artifact
+    # is emitted from the ledger — build-info + env fingerprint attached
+    results = {"headline": {"value": headline["value"],
+                            "vs_baseline": headline["vs_baseline"]},
+               "ramp_best": best}
+    results.update({k: v for k, v in matrix.items() if v is not None})
+    _ledger_record(results, source="bench", emit=True)
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":
@@ -2093,5 +2195,7 @@ if __name__ == "__main__":
         )
     elif len(sys.argv) >= 2 and sys.argv[1] == "--mesh-scaling-stage":
         mesh_scaling_child(int(sys.argv[2]))
+    elif os.environ.get("BENCH_GATE") == "1":
+        gate_main()
     else:
         main()

@@ -86,6 +86,21 @@ def _on_compile_duration(event: str, duration: float, **kw: Any) -> None:
         return
     global _compile_count
     _compile_count += 1
+    try:
+        # the perf layer's compile-duration stream (docs/perf.md):
+        # count + cumulative seconds, next to the trace mirror below
+        from ..obs.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "fishnet_compiles_total",
+            "XLA backend compiles observed via jax.monitoring",
+        ).inc()
+        REGISTRY.counter(
+            "fishnet_compile_seconds_total",
+            "Cumulative XLA backend compile wall time",
+        ).inc(float(duration))
+    except (ImportError, TypeError, ValueError):
+        pass  # metrics are best-effort; the trace mirror still runs
     rec = trace.RECORDER
     if rec is not None:
         dur_us = float(duration) * 1e6
@@ -364,6 +379,16 @@ class Registry:
         finally:
             _compile_current.program = ""
         prog.cache[key] = compiled
+        # program cost accounting (obs/perf.py): pack time is the one
+        # moment every search jit and mesh callable passes through here
+        # as a Compiled object, so the FLOPs/bytes/memory read is free
+        try:
+            if settings.get_bool("FISHNET_TPU_PERF_PROGRAMS"):
+                from ..obs import perf as _perf
+
+                _perf.record_program_cost(prog.name, compiled)
+        except (ImportError, TypeError, ValueError):
+            pass  # accounting is best-effort; the export still runs
         t = threading.Thread(
             target=self._export_one, args=(prog.name, key, meta, compiled),
             daemon=True, name=f"aot-export-{key[:8]}",

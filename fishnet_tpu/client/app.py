@@ -16,6 +16,7 @@ from typing import Optional, Set
 
 from ..engine.pyengine import PyEngine
 from ..obs import metrics as obs_metrics
+from ..obs import perf as obs_perf
 from ..obs import trace as obs_trace
 from ..utils import settings
 from .api import ApiClient, ApiError, Endpoint
@@ -198,6 +199,10 @@ async def run(cfg: Config) -> int:
     # recorder) and the Prometheus text endpoint on loopback
     if obs_trace.RECORDER is None:
         obs_trace.install_from_settings("client")
+    try:
+        obs_perf.register_build_info()
+    except (ImportError, TypeError, ValueError):
+        pass  # build-info gauge is best-effort decoration
     metrics_server = obs_metrics.serve_from_settings()
     if metrics_server is not None:
         logger.info(
@@ -428,6 +433,83 @@ def run_inflight(cfg: Config) -> int:
     return 0
 
 
+def run_perf(cfg: Config) -> int:
+    """`fishnet-tpu perf`: the performance surface in one screen — GET
+    /debug/perf from a running serve process (build info, program cost
+    table, perf counters, last ledger baseline), falling back to this
+    process's own view when no server is up (build info + the local
+    ledger; program costs need a live process that compiled
+    something)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    host = cfg.serve_host or settings.get_str("FISHNET_TPU_SERVE_HOST")
+    port = (
+        cfg.serve_port if cfg.serve_port is not None
+        else settings.get_int("FISHNET_TPU_SERVE_PORT")
+    )
+    url = f"http://{host}:{port}/debug/perf"
+    source = url
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as r:
+            snap = json.loads(r.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError):
+        source = "local (no serve process reachable)"
+        snap = obs_perf.live_snapshot()
+
+    print(f"perf: {source}")
+    build = snap.get("build") or {}
+    if build:
+        print("build: " + " ".join(
+            f"{k}={build[k]}" for k in sorted(build)))
+    fp = snap.get("fingerprint")
+    print(f"env fingerprint: {fp or '(no AOT store fingerprint)'}")
+
+    programs = snap.get("programs") or {}
+    if programs:
+        print("\nprogram cost (cost_analysis/memory_analysis at compile):")
+        cols = ("program", "flops", "bytes_accessed", "peak_bytes")
+        rows = [
+            (name,
+             *(f"{costs[c]:.3e}" if c in costs else "-"
+               for c in cols[1:]))
+            for name, costs in sorted(programs.items())
+        ]
+        widths = [
+            max(len(c), *(len(r[i]) for r in rows))
+            for i, c in enumerate(cols)
+        ]
+        print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+        for r in rows:
+            print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+
+    metrics = snap.get("metrics") or {}
+    if metrics:
+        print("\ncounters:")
+        for name in sorted(metrics):
+            print(f"  {name} = {metrics[name]:g}")
+    ratio = snap.get("cache_hit_ratio")
+    if ratio is not None:
+        print(f"  cache hit ratio = {ratio:.2%}")
+
+    baseline = snap.get("baseline")
+    if baseline:
+        print(
+            f"\nledger baseline: run {baseline.get('run_id')} "
+            f"(seq {baseline.get('seq')}, source "
+            f"{baseline.get('source')}, sha {baseline.get('git_sha')}, "
+            f"fingerprint {baseline.get('fingerprint') or '-'})"
+        )
+        for bench_row, metrics_row in sorted(
+                (baseline.get("rows") or {}).items()):
+            for metric, value in sorted(metrics_row.items()):
+                print(f"  {bench_row}.{metric} = {value:g}")
+    else:
+        print("\nledger baseline: (empty — run bench.py to seed it)")
+    return 0
+
+
 def run_fleet_ctl(cfg: Config) -> int:
     """`fishnet-tpu fleet-ctl [list | add SPEC | drain NAME | remove
     NAME]`: runtime membership against a running fleet front-end's
@@ -544,6 +626,10 @@ def main(argv=None) -> int:
         # live in-flight introspection against a running serve process
         # (obs/inflight.py; --serve-host/--serve-port pick the target)
         return run_inflight(cfg)
+    if cfg.command == "perf":
+        # build info, program cost table, and the perf-ledger baseline
+        # (obs/perf.py; reaches a serve process's /debug/perf if up)
+        return run_perf(cfg)
     if cfg.command in ("serve", "fleet"):
         # the analysis-serving front-end (fishnet_tpu/serve/): many
         # concurrent HTTP tenants multiplex into the same lane pool the

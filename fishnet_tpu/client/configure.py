@@ -173,7 +173,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", nargs="?", default="run",
                    choices=["run", "configure", "systemd", "systemd-user",
                             "license", "bench", "serve", "fleet",
-                            "pack", "warm", "inflight", "fleet-ctl"])
+                            "pack", "warm", "inflight", "fleet-ctl",
+                            "perf"])
     p.add_argument("subargs", nargs="*", default=[],
                    help="subcommand arguments (fleet-ctl: list | "
                         "add SPEC | drain NAME | remove NAME)")

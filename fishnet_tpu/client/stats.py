@@ -347,6 +347,40 @@ class StatsRecorder:
         except sqlite3.Error:
             return []
 
+    # --------------------------------------------------------- perf ledger
+    #
+    # The longitudinal perf ledger (fishnet_tpu/obs/perf.py, docs/perf.md)
+    # shares this sink's plumbing: same schema helpers, so the client's
+    # stats.db can carry the perf_ledger table next to the stats/metrics
+    # time series, while bench.py and tools/perf_report.py use their own
+    # standalone ledger file at the checkout root.
+
+    def ensure_perf_table(self) -> bool:
+        """Create the perf_ledger table; False if no db sink."""
+        if self._db is None:
+            return False
+        try:
+            from ..obs.perf import ensure_perf_table
+
+            ensure_perf_table(self._db)
+            self._db.commit()
+            return True
+        except sqlite3.Error:
+            return False
+
+    def record_perf(self, run_id: str, rows: dict, **kw) -> int:
+        """Ingest one run's bench_row → {metric: value} table into the
+        perf ledger (obs/perf.py insert_perf_rows); returns rows
+        written, 0 when there is no db sink."""
+        if self._db is None:
+            return 0
+        try:
+            from ..obs.perf import insert_perf_rows
+
+            return insert_perf_rows(self._db, run_id, rows, **kw)
+        except sqlite3.Error:
+            return 0
+
     def min_user_backlog(self) -> float:
         """Seconds of user-queue backlog below which this client should not
         take user-facing jobs: clients slower than the ~2 Mnodes / 6 s

@@ -440,11 +440,11 @@ class FleetCoordinator(ChunkSubmit):
         # the hedging acceptance counters under their contract names
         # (docs/fleet.md): duplicates dispatched, duplicates that won
         reg.counter(
-            "fleet_hedges_total",
+            "fishnet_fleet_hedges_total",
             "Positions duplicated to a second member by hedged dispatch",
         ).set_total(self.stats.hedges)
         reg.counter(
-            "fleet_hedge_wins_total",
+            "fishnet_fleet_hedge_wins_total",
             "Hedged positions whose duplicate answered first",
         ).set_total(self.stats.hedge_wins)
         for m in self.members:

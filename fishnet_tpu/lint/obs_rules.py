@@ -29,10 +29,31 @@ Rules:
                    WorkPosition's ctx, proven by the wire-schema lint);
                    and a ServeRequest(...) construction without the
                    position_ctx field.
+  obs-metric-name  every name registered on the MetricsRegistry
+                   (counter/gauge/histogram on a REGISTRY/registry/reg
+                   receiver, plus absorb_totals prefixes) must follow
+                   the exported-namespace grammar `fishnet_[a-z0-9_]+`
+                   and the unit-suffix convention: counters carry a
+                   `_total` token, histograms a `_ms`/`_seconds`/
+                   `_bytes` unit token (`_ratio` for dimensionless
+                   shares). Gauges are charset-only —
+                   point-in-time ratios/levels (`fishnet_lanes_live`,
+                   `fishnet_cache_hit_ratio_*`) have no natural unit,
+                   and mirrored externally-kept totals
+                   (`fishnet_fleet_members_total`) keep their source
+                   name. Names the registry would have to mangle
+                   (_sanitize) or that land outside the `fishnet_`
+                   namespace never reach a dashboard query unscathed;
+                   the perf ledger joins on these exact strings.
+                   F-string names are checked on their literal
+                   fragments; one with a leading interpolation (the
+                   SloRecorder `{self.prefix}_...` family) is the
+                   caller's namespace choice and is skipped.
 """
 from __future__ import annotations
 
 import ast
+import re
 from typing import List, Optional, Set, Tuple
 
 from .core import Finding, Project, SourceFile, dotted, register_family
@@ -165,6 +186,116 @@ def check_obs_orphan_span(project: Project) -> List[Finding]:
                     "chunk_to_serve_request is the reference shape)"
                 )
             findings.append(src.finding("obs-orphan-span", node, msg))
+    return findings
+
+
+# ----------------------------------------------------------- metric names
+
+# the exported-namespace grammar every registered metric name obeys
+_METRIC_NAME_RE = re.compile(r"^fishnet_[a-z0-9_]+$")
+# charset a literal f-string fragment may use (interpolations fill the
+# rest; the registry's _sanitize would mangle anything else)
+_METRIC_FRAGMENT_RE = re.compile(r"^[a-z0-9_]*$")
+# registry receivers; excludes the trace recorder (`rec.counter(...)`
+# in engine/tpu.py emits trace counter events, a different namespace)
+_REGISTRY_RECEIVERS = {"REGISTRY", "registry", "reg"}
+_METRIC_KINDS = {"counter", "gauge", "histogram"}
+_HISTOGRAM_UNITS = {"ms", "seconds", "bytes", "ratio"}
+
+
+def _metric_name_tokens(node: ast.AST) -> Optional[Set[str]]:
+    """The `_`-split tokens of a metric-name expression's literal text,
+    or None when the expression can't be charset/unit checked (a
+    variable, or an f-string led by an interpolation). Raises ValueError
+    with a reason when a literal violates the grammar."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if not _METRIC_NAME_RE.match(node.value):
+            raise ValueError(
+                f'"{node.value}" is outside the metric grammar '
+                "fishnet_[a-z0-9_]+"
+            )
+        return {t for t in node.value.split("_") if t}
+    if isinstance(node, ast.JoinedStr):
+        if not node.values or isinstance(node.values[0], ast.FormattedValue):
+            return None  # leading interpolation: namespace is the caller's
+        tokens: Set[str] = set()
+        for i, piece in enumerate(node.values):
+            if not (isinstance(piece, ast.Constant)
+                    and isinstance(piece.value, str)):
+                continue
+            frag = piece.value
+            if i == 0:
+                if not frag.startswith("fishnet_"):
+                    raise ValueError(
+                        f'f-string metric name starts with "{frag}" — '
+                        "exported names live in the fishnet_ namespace"
+                    )
+            if not _METRIC_FRAGMENT_RE.match(frag):
+                raise ValueError(
+                    f'f-string fragment "{frag}" is outside the metric '
+                    "charset [a-z0-9_]"
+                )
+            tokens.update(t for t in frag.split("_") if t)
+        return tokens
+    return None  # dynamic name; nothing checkable statically
+
+
+def _metric_sites(src: SourceFile) -> List[Tuple[str, ast.Call]]:
+    """(kind, call) for every registry registration in this file:
+    counter/gauge/histogram on a REGISTRY-shaped receiver, and
+    absorb_totals (whose prefix becomes `{prefix}_{key}` gauge/counter
+    names) on any receiver."""
+    sites: List[Tuple[str, ast.Call]] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr == "absorb_totals":
+            sites.append(("absorb_totals", node))
+        elif fn.attr in _METRIC_KINDS:
+            if _last_component(dotted(fn.value)) in _REGISTRY_RECEIVERS:
+                sites.append((fn.attr, node))
+    return sites
+
+
+@register_family("obs")
+def check_obs_metric_name(project: Project) -> List[Finding]:
+    """Metric-name discipline: the exported namespace grammar plus the
+    per-kind unit-suffix convention (see module docstring)."""
+    findings: List[Finding] = []
+    for src in project.in_dirs("fishnet_tpu", "tools", "bench.py"):
+        for kind, call in _metric_sites(src):
+            if not call.args:
+                continue
+            try:
+                tokens = _metric_name_tokens(call.args[0])
+            except ValueError as e:
+                findings.append(src.finding(
+                    "obs-metric-name", call,
+                    f"{e} — dashboards and the perf ledger join on the "
+                    "exact exported string",
+                ))
+                continue
+            if tokens is None:
+                continue
+            if kind == "counter" and "total" not in tokens:
+                findings.append(src.finding(
+                    "obs-metric-name", call,
+                    "counter without a _total token — Prometheus "
+                    "convention marks monotonic series with _total; "
+                    "rate() queries and the perf direction table key "
+                    "off it",
+                ))
+            elif kind == "histogram" and not (tokens & _HISTOGRAM_UNITS):
+                findings.append(src.finding(
+                    "obs-metric-name", call,
+                    "histogram without a unit token (_ms/_seconds/"
+                    "_bytes, or _ratio for dimensionless shares) — "
+                    "bucket bounds are meaningless without the unit "
+                    "in the name",
+                ))
     return findings
 
 

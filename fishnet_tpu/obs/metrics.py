@@ -32,6 +32,7 @@ __all__ = [
     "SloRecorder",
     "serve",
     "serve_from_settings",
+    "set_build_info",
 ]
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
@@ -314,6 +315,23 @@ class SloRecorder:
 
 # The process-wide default registry every subsystem feeds.
 REGISTRY = MetricsRegistry()
+
+
+def set_build_info(info: Dict[str, object],
+                   registry: Optional[MetricsRegistry] = None) -> Gauge:
+    """The standard Prometheus build-info idiom, adapted to this
+    registry's label-less model: a `fishnet_build_info` gauge pinned at
+    1 whose identifying fields (git sha, jax/jaxlib versions, backend,
+    device kind/count — collected by obs/perf.py build_info()) render
+    in the HELP line of every /metrics scrape. The same dict is stamped
+    into perf-ledger rows and trace dump metadata, so one scrape
+    suffices to join a host's series across those surfaces."""
+    reg = registry if registry is not None else REGISTRY
+    help_text = " ".join(f"{k}={info[k]}" for k in sorted(info))
+    g = reg.gauge("fishnet_build_info", help_text)
+    g.help = help_text  # refresh if registered earlier with stale info
+    g.set(1.0)
+    return g
 
 
 def serve(port: int, registry: Optional[MetricsRegistry] = None):

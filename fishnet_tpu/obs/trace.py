@@ -317,13 +317,23 @@ class TraceRecorder:
 
     def export(self, window_s: Optional[float] = None) -> dict:
         """The Chrome trace-event JSON object — load the dumped file
-        straight into Perfetto / chrome://tracing."""
+        straight into Perfetto / chrome://tracing. Top-level
+        `buildInfo` (git sha, jax versions, backend, devices — the
+        perf layer's cross-host join key) is an extra key the trace
+        viewers ignore and tools/trace_report.py --compare reports."""
         evs = self.snapshot(window_s)
         evs.sort(key=lambda e: float(e.get("ts", 0.0)))
-        return {
+        out = {
             "traceEvents": self._metadata_events() + evs,
             "displayTimeUnit": "ms",
         }
+        try:
+            from . import perf
+
+            out["buildInfo"] = perf.build_info()
+        except Exception:
+            pass  # a dump without build info is still a valid trace
+        return out
 
     def dump(self, path: str, window_s: Optional[float] = None) -> str:
         """Write the export atomically (tmp + rename): a watcher tailing

@@ -38,6 +38,7 @@ from ..engine.base import EngineError
 from ..engine.session import EngineSession
 from ..obs import inflight as obs_inflight
 from ..obs import metrics as obs_metrics
+from ..obs import perf as obs_perf
 from ..obs import trace as obs_trace
 from ..utils import settings
 from .admission import AdmissionController, Shed
@@ -290,6 +291,15 @@ class ServeApp:
                 return 405, {"error": "use GET"}, {}
             reqs = self.inflight.snapshot()
             return 200, {"inflight": len(reqs), "requests": reqs}, {}
+        if path == "/debug/perf":
+            if method != "GET":
+                return 405, {"error": "use GET"}, {}
+            # current perf snapshot next to the last ledger baseline
+            # (obs/perf.py, docs/perf.md); `python -m fishnet_tpu perf`
+            # renders this payload as a table
+            from ..obs import perf as obs_perf
+
+            return 200, obs_perf.live_snapshot(), {}
         if path == "/fleet/members":
             return await self._fleet_members(method, body)
         kind = _ENDPOINTS.get(path)
@@ -639,6 +649,10 @@ async def run_serve(cfg) -> int:
     # ephemeral port (FISHNET_TPU_SERVE_PORT=0)
     logger.headline(f"serve: listening on {bound_host}:{bound_port}")
 
+    try:
+        obs_perf.register_build_info()
+    except (ImportError, TypeError, ValueError):
+        pass  # build-info gauge is best-effort decoration
     metrics_server = obs_metrics.serve_from_settings()
     if metrics_server is not None:
         logger.info(

@@ -642,6 +642,47 @@ SETTINGS: Tuple[Setting, ...] = (
             "fresh process. Off (default) adds zero overhead.",
         engine=True,
     ),
+    Setting(
+        name="FISHNET_TPU_PERF_LEDGER",
+        kind="str",
+        default="",
+        doc="Path of the perf-ledger sqlite file (obs/perf.py, "
+            "docs/perf.md). Empty (default) resolves to perf_ledger.db "
+            "at the checkout root, falling back to "
+            "~/.cache/fishnet-tpu/perf_ledger.db for installed "
+            "packages. bench.py appends every RESULT row here; "
+            "tools/perf_report.py reads the history back for the "
+            "regression gate.",
+    ),
+    Setting(
+        name="FISHNET_TPU_PERF_WINDOW",
+        kind="int",
+        default="5",
+        doc="Rolling-baseline window for the perf regression detector: "
+            "how many prior same-fingerprint ledger runs average into "
+            "the baseline each metric is compared against.",
+    ),
+    Setting(
+        name="FISHNET_TPU_PERF_BAND",
+        kind="str",
+        default="0.02",
+        doc="Minimum relative noise band (fraction) for deterministic "
+            "counter metrics in tools/perf_report.py --check; the "
+            "band widens automatically to 2x the baseline's relative "
+            "stddev when history is noisier than this floor. "
+            "Wall-clock metrics use a fixed 15% band and never gate.",
+    ),
+    Setting(
+        name="FISHNET_TPU_PERF_PROGRAMS",
+        kind="bool",
+        default="1",
+        doc="Program cost accounting (obs/perf.py): read "
+            "cost_analysis()/memory_analysis() off AOT-compiled "
+            "executables wherever a Compiled object already exists "
+            "(bench precompile, AOT registry export) and export "
+            "fishnet_program_* gauges. Capture never triggers an "
+            "extra compile; off skips even the cheap reads.",
+    ),
 )
 
 _BY_NAME: Dict[str, Setting] = {s.name: s for s in SETTINGS}

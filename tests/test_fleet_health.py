@@ -435,8 +435,8 @@ def test_hedged_dispatch_first_answer_wins(tmp_path):
         assert hedged.stats.hedge_wins >= 1
         assert hedged.stats.losses == 0  # the straggler was slow, not dead
         snap = registry.snapshot()
-        assert snap["fleet_hedges_total"] == hedged.stats.hedges
-        assert snap["fleet_hedge_wins_total"] == hedged.stats.hedge_wins
+        assert snap["fishnet_fleet_hedges_total"] == hedged.stats.hedges
+        assert snap["fishnet_fleet_hedge_wins_total"] == hedged.stats.hedge_wins
         # the fast member served its own sub-chunk AND the hedge copy
         fast_gos = [r for r in read_echo(echo_fast) if r["t"] == "go"]
         assert len(fast_gos) == 2

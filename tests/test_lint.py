@@ -1773,3 +1773,85 @@ def test_lint_report_sarif(tmp_path):
     loc = res[0]["locations"][0]["physicalLocation"]
     assert loc["artifactLocation"]["uri"] == "fishnet_tpu/client/queue.py"
     assert loc["region"]["startLine"] == 2
+
+
+# ----------------------------------------------------------- metric names
+
+
+METRIC_BAD = '''
+from fishnet_tpu.obs.metrics import REGISTRY
+
+
+def fold(reg, tenant):
+    reg.counter("hedges_total")                     # outside fishnet_
+    reg.counter("fishnet_hedges")                   # counter, no _total
+    reg.histogram("fishnet_latency")                # histogram, no unit
+    REGISTRY.gauge("Fishnet_Bad-Name")              # charset
+    reg.counter(f"cache_{tenant}_total")            # f-string namespace
+    reg.absorb_totals("supervisor", {})             # prefix namespace
+'''
+
+METRIC_CLEAN = '''
+def fold(reg, rec, tenant, name):
+    reg.counter("fishnet_fleet_hedges_total")
+    reg.counter("fishnet_compile_seconds_total")
+    reg.gauge("fishnet_lanes_live")                 # gauges: charset only
+    reg.gauge("fishnet_fleet_members_total")        # mirrored total
+    reg.histogram("fishnet_boundary_host_ms")
+    reg.histogram(f"fishnet_cache_hit_ratio_{tenant}")
+    reg.counter(f"fishnet_serve_{name}_total_{tenant}")
+    reg.absorb_totals("fishnet_supervisor", {})
+    reg.counter(name)                               # dynamic: unchecked
+    rec.counter("lanes.live", 3, "engine")          # trace recorder
+'''
+
+
+def test_metric_name_violations_flagged(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/obs/fold.py": METRIC_BAD}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-metric-name")
+    assert len(found) == 6
+    assert [f.line for f in found] == [6, 7, 8, 9, 10, 11]
+
+
+def test_metric_name_clean_forms(tmp_path):
+    project = make_project(
+        tmp_path, {"fishnet_tpu/obs/fold.py": METRIC_CLEAN}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-metric-name") == []
+
+
+def test_metric_name_slo_prefix_is_callers_choice(tmp_path):
+    # SloRecorder-style names lead with an interpolated prefix; the
+    # namespace decision happens at the construction site, not here
+    src = '''
+class SloRecorder:
+    def observe(self, reg, what, kind, tenant, v):
+        reg.histogram(f"{self.prefix}_{what}_ms_{kind}_{tenant}").observe(v)
+'''
+    project = make_project(
+        tmp_path, {"fishnet_tpu/obs/slo.py": src}
+    )
+    result = run_lint(project, only_families={"obs"})
+    assert by_rule(result.findings, "obs-metric-name") == []
+
+
+def test_mutated_hedge_counter_name_is_caught(tmp_path):
+    """Mutation test: strip the namespace prefix back off the fleet
+    hedge counters (the exact drift this rule exists to stop) and
+    assert both registrations are flagged."""
+    real = (REPO_ROOT / "fishnet_tpu/fleet/coordinator.py").read_text()
+    assert real.count('"fishnet_fleet_hedges_total"') == 1
+    broken = real.replace(
+        '"fishnet_fleet_hedges_total"', '"fleet_hedges_total"').replace(
+        '"fishnet_fleet_hedge_wins_total"', '"fleet_hedge_wins_total"')
+    project = make_project(
+        tmp_path, {"fishnet_tpu/fleet/coordinator.py": broken}
+    )
+    result = run_lint(project, only_families={"obs"})
+    found = by_rule(result.findings, "obs-metric-name")
+    assert len(found) == 2
+    assert all("fishnet_" in f.message for f in found)

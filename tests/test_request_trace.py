@@ -527,3 +527,26 @@ def test_refill_lifecycle_spans_and_bit_identity(recorder):
         assert g.nodes == w.nodes
         assert g.scores.matrix == w.scores.matrix
         assert g.pvs.matrix == w.pvs.matrix
+
+
+def test_debug_perf_surface():
+    """GET /debug/perf returns the JSON-safe perf snapshot: build info,
+    env fingerprint, program/metric tables, and the ledger baseline
+    column (None here — no ledger seeded)."""
+
+    async def scenario():
+        session = GatedSession()
+        app = ServeApp(session, max_inflight=4, max_queue=4,
+                       default_timeout_ms=8000, drain_s=5.0)
+        host, port = await app.start("127.0.0.1", 0)
+        try:
+            return await _http(host, port, "GET", "/debug/perf")
+        finally:
+            await app.drain_and_stop()
+
+    status, snap = asyncio.run(scenario())
+    assert status == 200
+    json.dumps(snap)  # must be JSON-safe end to end
+    assert "git_sha" in snap["build"]
+    assert "fingerprint" in snap and "programs" in snap
+    assert isinstance(snap["metrics"], dict)

@@ -204,6 +204,45 @@ def test_trace_report_rejects_garbage(tmp_path):
     assert trace_report.main([str(bad)]) == 2
 
 
+def _gap_dump(path, n_seg, seg_us, gap_us, extra=None):
+    evs = []
+    ts = 0.0
+    for _ in range(n_seg):
+        evs.append({"ph": "X", "name": "segment", "ts": ts, "dur": seg_us,
+                    "pid": 1, "tid": 1, "args": {}})
+        ts += seg_us + gap_us
+    if extra:
+        evs.append(extra)
+    doc = {"traceEvents": evs,
+           "buildInfo": {"git_sha": "abc", "backend": "cpu"}}
+    path.write_text(json.dumps(doc), encoding="utf-8")
+
+
+def test_trace_report_compare(tmp_path, capsys):
+    """--compare A B: the boundary-gap shift and per-phase share
+    movement between two dumps, each labeled with its buildInfo."""
+    a, b = tmp_path / "A.json", tmp_path / "B.json"
+    _gap_dump(a, 4, 1000.0, 200.0)
+    # candidate: 3x the boundary gap plus a phase A never had
+    _gap_dump(b, 4, 1000.0, 600.0,
+              extra={"ph": "X", "name": "warmup", "ts": 0.0,
+                     "dur": 2000.0, "pid": 1, "tid": 2})
+    ra = trace_report.summarize(trace_report.load_events(str(a)))
+    rb = trace_report.summarize(trace_report.load_events(str(b)))
+    cmp = trace_report.compare(ra, rb)
+    gaps = cmp["boundary_gaps"]
+    assert gaps["a_mean_ms"] == pytest.approx(0.2)
+    assert gaps["b_mean_ms"] == pytest.approx(0.6)
+    assert gaps["mean_delta_ms"] == pytest.approx(0.4)
+    assert cmp["phases"]["warmup"]["ratio"] is None  # new phase
+    assert cmp["phases"]["segment"]["share_delta"] < 0  # diluted
+
+    assert trace_report.main(["--compare", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "boundary gaps" in out
+    assert "git_sha=abc" in out  # both sides' build stamps render
+
+
 # ------------------------------------------- cross-process (fake host)
 
 

@@ -40,7 +40,7 @@ gauntlet (healthz + canary) once the proxy recovers — all with
 bit-identical answers. fleet-straggler-hedge runs a 3-member fleet
 with one 400ms straggler, hedge off then on: hedging must cut p99
 chunk latency, keep every position exactly-once, count its wins in
-fleet_hedges_total/fleet_hedge_wins_total, and stay bit-identical.
+fishnet_fleet_hedges_total/fishnet_fleet_hedge_wins_total, and stay bit-identical.
 
 `--scenario burst-member-loss` and `--scenario flap-under-load` are
 the elastic-capacity gates (ISSUE 16) — chaos UNDER load.
@@ -793,10 +793,10 @@ async def fleet_hedge_scenario(args) -> int:
             "straggler-hedge: a slow member was treated as dead "
             f"(losses on={stats_on.losses} off={stats_off.losses})"
         )
-    if snap_on.get("fleet_hedges_total") != stats_on.hedges or \
-            snap_on.get("fleet_hedge_wins_total") != stats_on.hedge_wins:
+    if snap_on.get("fishnet_fleet_hedges_total") != stats_on.hedges or \
+            snap_on.get("fishnet_fleet_hedge_wins_total") != stats_on.hedge_wins:
         problems.append(
-            "straggler-hedge: fleet_hedges_total/fleet_hedge_wins_total "
+            "straggler-hedge: fishnet_fleet_hedges_total/fishnet_fleet_hedge_wins_total "
             "do not tie out with the coordinator ledger"
         )
     if not p99_on < p99_off:

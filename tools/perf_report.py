@@ -1,0 +1,323 @@
+"""Regression detector over the perf ledger (fishnet_tpu/obs/perf.py).
+
+Compares the latest ledger run against a rolling baseline built from
+prior runs measured under the SAME env fingerprint (the AOT store
+fingerprint digest, aot/keys.py) and classifies every metric through
+the direction table:
+
+- **direction**: up (throughput — nps, positions/s, positions_per_kstep,
+  scaling_x, occupancy fractions, cache warm ratio), down (latency and
+  overheads — p50/p99, dt, host_ms, transfers, shed/deadline misses),
+  or flat (deterministic totals that must not move at all for a fixed
+  workload — nodes, steps, refills, segments, positions done).
+- **stability tier**: `counter` metrics are deterministic on a fixed
+  workload (search is bit-reproducible), so they gate hard in CI;
+  `wallclock` metrics vary with the runner and only ever annotate.
+
+Noise bands come from the baseline history itself (2x the relative
+stddev, floored at FISHNET_TPU_PERF_BAND for counters / 15% for wall
+clock). Rows are gated only when fingerprints match exactly: a run
+with no fingerprint (backfilled artifacts, no-JAX environments) or a
+fingerprint unseen in history is compared report-only — never failed —
+and a metric with no baseline passes by definition (first run).
+
+Usage:
+  python tools/perf_report.py                  # report, text table
+  python tools/perf_report.py --check          # exit 1 on regression
+  python tools/perf_report.py --check --format=github   # CI perf-gate
+  python tools/perf_report.py --json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from fishnet_tpu.obs import perf  # noqa: E402
+
+# (pattern matched against the metric's last dotted component,
+#  direction, stability tier). First match wins; order matters —
+# e.g. positions_per_kstep (up/counter) before the bare positions
+# total (flat/counter).
+DIRECTION_TABLE: Tuple[Tuple[str, str, str], ...] = (
+    (r"^positions_per_kstep$", "up", "counter"),
+    (r"^scaling_x$", "up", "counter"),
+    (r"^efficiency$", "up", "counter"),
+    (r"^(mean_live_frac|mean_live_occupancy|shard_mean_live|occupancy)$",
+     "up", "counter"),
+    (r"^(hit_ratio|warm_x|speedup|bit_identical|ok)$", "up", "counter"),
+    (r"^(transfers|transfers_per_boundary)$", "down", "counter"),
+    (r"^(nodes|primary_nodes|steps|steps_per_shard|segments|refills|"
+     r"boundaries|positions|positions_done|done|helpers|entries|"
+     r"coalesced|rc)$", "flat", "counter"),
+    (r"^(nps|positions_per_s|positions_done_per_s|value|vs_baseline|"
+     r"rps)$", "up", "wallclock"),
+    (r"(^|_)(p50|p90|p99|p999)(_ms)?$", "down", "wallclock"),
+    (r"(_ms|_s|_seconds)$", "down", "wallclock"),
+    (r"^(dt|shed|deadline_miss|miss_rate|misses)$", "down", "wallclock"),
+    (r"^(flops|bytes_accessed|peak_bytes|argument_bytes|output_bytes|"
+     r"code_bytes)$", "down", "counter"),
+)
+
+_COMPILED_TABLE = [
+    (re.compile(pat), direction, tier)
+    for pat, direction, tier in DIRECTION_TABLE
+]
+
+# minimum relative noise bands per tier (the stddev-derived band can
+# only widen these); counters override via FISHNET_TPU_PERF_BAND
+DEFAULT_COUNTER_BAND = 0.02
+WALLCLOCK_BAND = 0.15
+
+
+def classify(metric: str) -> Tuple[str, str]:
+    """(direction, tier) for one (possibly dotted) metric name;
+    unmatched names report-only as ('flat', 'wallclock')."""
+    leaf = metric.rsplit(".", 1)[-1]
+    for rx, direction, tier in _COMPILED_TABLE:
+        if rx.search(leaf):
+            return direction, tier
+    return "flat", "wallclock"
+
+
+def counter_band() -> float:
+    try:
+        from fishnet_tpu.utils import settings
+
+        raw = settings.get_str("FISHNET_TPU_PERF_BAND")
+        if raw:
+            return max(0.0, float(raw))
+    except Exception:
+        pass
+    return DEFAULT_COUNTER_BAND
+
+
+def noise_band(history: List[float], tier: str,
+               min_counter_band: Optional[float] = None) -> float:
+    """Relative band: 2x the baseline's relative stddev, floored at
+    the tier minimum — a noisy series earns itself a wide band, a
+    perfectly stable counter series keeps the tight floor."""
+    floor = (min_counter_band if min_counter_band is not None
+             else counter_band()) if tier == "counter" else WALLCLOCK_BAND
+    if len(history) < 2:
+        return floor
+    mean = statistics.fmean(history)
+    if mean == 0:
+        return floor
+    rel = statistics.pstdev(history) / abs(mean)
+    return max(floor, 2.0 * rel)
+
+
+def evaluate(ledger: "perf.PerfLedger", window: int = 5,
+             min_counter_band: Optional[float] = None) -> Dict:
+    """The full comparison of the latest run vs its rolling baseline.
+    Returns {run, rows: [...]}; each row carries status:
+      ok / regression / improved / no-baseline / unfingerprinted
+    and `gated` (hard-fail eligible: counter tier + matching
+    fingerprint + a real baseline)."""
+    latest = ledger.latest_run()
+    if latest is None:
+        return {"run": None, "rows": []}
+    fingerprint = latest.get("fingerprint") or ""
+    rows: List[Dict] = []
+    for bench_row, metrics in sorted(
+            ledger.run_metrics(latest["run_id"]).items()):
+        for metric, value in sorted(metrics.items()):
+            direction, tier = classify(metric)
+            entry: Dict = {
+                "bench_row": bench_row,
+                "metric": metric,
+                "value": value,
+                "direction": direction,
+                "tier": tier,
+                "baseline": None,
+                "band": None,
+                "delta": None,
+                "gated": False,
+            }
+            if not fingerprint:
+                entry["status"] = "unfingerprinted"
+                rows.append(entry)
+                continue
+            hist = [
+                v for _, v in ledger.history(
+                    bench_row, metric, fingerprint=fingerprint,
+                    before_seq=latest["seq"], limit=window,
+                )
+            ]
+            if not hist:
+                entry["status"] = "no-baseline"
+                rows.append(entry)
+                continue
+            baseline = statistics.fmean(hist)
+            band = noise_band(hist, tier, min_counter_band)
+            delta = ((value - baseline) / abs(baseline)
+                     if baseline != 0 else (0.0 if value == 0 else 1.0))
+            entry.update(baseline=round(baseline, 6), band=round(band, 6),
+                         delta=round(delta, 6))
+            entry["gated"] = tier == "counter"
+            if direction == "up":
+                worse, better = delta < -band, delta > band
+            elif direction == "down":
+                worse, better = delta > band, delta < -band
+            else:  # flat: any out-of-band move is a regression
+                worse, better = abs(delta) > band, False
+            entry["status"] = (
+                "regression" if worse else "improved" if better else "ok"
+            )
+            rows.append(entry)
+    return {"run": latest, "rows": rows}
+
+
+def hard_regressions(report: Dict) -> List[Dict]:
+    return [
+        r for r in report["rows"]
+        if r["status"] == "regression" and r["gated"]
+    ]
+
+
+def soft_regressions(report: Dict) -> List[Dict]:
+    return [
+        r for r in report["rows"]
+        if r["status"] == "regression" and not r["gated"]
+    ]
+
+
+def _fmt_delta(row: Dict) -> str:
+    return f"{row['delta']:+.1%}" if row["delta"] is not None else "·"
+
+
+def render_text(report: Dict) -> str:
+    run = report["run"]
+    if run is None:
+        return "perf-report: empty ledger (no runs recorded)"
+    lines = [
+        f"run {run['run_id']} (seq {run['seq']}, sha "
+        f"{run['git_sha'] or '?'}, env {run['fingerprint'] or 'none'}, "
+        f"source {run['source']})",
+        "",
+        f"{'row':<28} {'metric':<34} {'value':>14} {'baseline':>14} "
+        f"{'Δ':>8} {'dir':<4} {'tier':<9} status",
+    ]
+    for r in report["rows"]:
+        base = (f"{r['baseline']:>14.4g}" if r["baseline"] is not None
+                else f"{'·':>14}")
+        lines.append(
+            f"{r['bench_row']:<28} {r['metric']:<34} {r['value']:>14.4g} "
+            f"{base} {_fmt_delta(r):>8} {r['direction']:<4} "
+            f"{r['tier']:<9} {r['status']}"
+        )
+    hard, soft = hard_regressions(report), soft_regressions(report)
+    lines += [
+        "",
+        f"{len(report['rows'])} metrics: "
+        f"{len(hard)} gated regression(s), "
+        f"{len(soft)} report-only regression(s)",
+    ]
+    return "\n".join(lines)
+
+
+def render_github(report: Dict) -> str:
+    run = report["run"]
+    out: List[str] = []
+    if run is None:
+        out.append("::notice title=perf-report::empty ledger, nothing "
+                   "to gate")
+        return "\n".join(out)
+    for r in hard_regressions(report):
+        out.append(
+            f"::error title=perf-gate {r['bench_row']}.{r['metric']}::"
+            f"deterministic {r['direction']}-metric moved "
+            f"{_fmt_delta(r)} vs rolling baseline {r['baseline']:g} "
+            f"(band ±{r['band']:.1%})"
+        )
+    for r in soft_regressions(report):
+        out.append(
+            f"::warning title=perf-drift {r['bench_row']}.{r['metric']}::"
+            f"wall-clock metric moved {_fmt_delta(r)} vs baseline "
+            f"{r['baseline']:g} (band ±{r['band']:.1%}; report-only)"
+        )
+    hard, soft = hard_regressions(report), soft_regressions(report)
+    out.append(
+        f"::notice title=perf-report::run {run['run_id']}: "
+        f"{len(report['rows'])} metrics, {len(hard)} gated regressions, "
+        f"{len(soft)} wall-clock drifts"
+    )
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="perf-report")
+    parser.add_argument(
+        "--ledger", default=None,
+        help="sqlite ledger path (default: FISHNET_TPU_PERF_LEDGER or "
+             "perf_ledger.db at the checkout root; created + backfilled "
+             "from BENCH_r*/MULTICHIP_r* artifacts when missing)",
+    )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="exit 1 when any deterministic counter metric regresses "
+             "out of band (wall-clock drift never fails)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full report as JSON")
+    parser.add_argument(
+        "--format", choices=["text", "github"], default="text",
+        help="github: workflow error/warning/notice annotations",
+    )
+    parser.add_argument(
+        "--window", type=int, default=None,
+        help="rolling-baseline window in runs "
+             "(default FISHNET_TPU_PERF_WINDOW)",
+    )
+    parser.add_argument(
+        "--no-backfill", action="store_true",
+        help="do not ingest checked-in BENCH/MULTICHIP artifacts into "
+             "a fresh ledger",
+    )
+    args = parser.parse_args(argv)
+
+    window = args.window
+    if window is None:
+        try:
+            from fishnet_tpu.utils import settings
+
+            window = settings.get_int("FISHNET_TPU_PERF_WINDOW")
+        except Exception:
+            window = 5
+    window = max(1, window)
+
+    path = args.ledger or perf.default_ledger_path()
+    fresh = not os.path.exists(path)
+    ledger = perf.PerfLedger.open(path)
+    try:
+        if fresh and not args.no_backfill:
+            n = ledger.backfill()
+            if n and args.format != "github":
+                print(f"perf-report: backfilled {n} metric rows from "
+                      "checked-in artifacts", file=sys.stderr)
+        report = evaluate(ledger, window=window)
+    finally:
+        ledger.close()
+
+    if args.json:
+        print(json.dumps(report, indent=2))
+    elif args.format == "github":
+        print(render_github(report))
+    else:
+        print(render_text(report))
+
+    if args.check and hard_regressions(report):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

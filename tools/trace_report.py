@@ -35,6 +35,12 @@ Usage:
   python tools/trace_report.py TRACE.json --format=github   # CI step
   python tools/trace_report.py TRACE.json --json
   python tools/trace_report.py TRACE.json --request aabbccdd11223344
+  python tools/trace_report.py --compare BASE.json CAND.json
+
+`--compare A B` diffs two dumps — per-phase time-share movement and
+the boundary-gap distribution shift, with each dump's `buildInfo`
+stamp (obs/perf.py) rendered so you know which build produced which
+side.
 """
 from __future__ import annotations
 
@@ -154,9 +160,115 @@ def summarize(events: List[dict]) -> dict:
             "count": len(gaps_ms),
             "buckets_ms": list(GAP_BUCKETS_MS),
             "histogram": hist,
+            "mean_ms": round(sum(gaps_ms) / len(gaps_ms), 3)
+            if gaps_ms else 0.0,
             "max_ms": round(max(gaps_ms), 3) if gaps_ms else 0.0,
         },
     }
+
+
+def load_build_info(path: str) -> dict:
+    """The `buildInfo` stamp obs/trace.py export() writes at the dump's
+    top level (absent on bare-array dumps and pre-stamp files)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError, json.JSONDecodeError):
+        return {}
+    if isinstance(obj, dict) and isinstance(obj.get("buildInfo"), dict):
+        return obj["buildInfo"]
+    return {}
+
+
+def compare(a: dict, b: dict) -> dict:
+    """Diff two summarize() reports: per-phase time-share movement and
+    the boundary-gap distribution shift. `a` is the baseline, `b` the
+    candidate; deltas are b - a (so positive share_delta = that phase
+    grew). The phase table covers the union of names, with phases
+    present on only one side carried at zero on the other — a phase
+    appearing or vanishing is itself signal (e.g. a warmup span that
+    stopped amortizing)."""
+    names = list(
+        dict.fromkeys(list(a["phases"].keys()) + list(b["phases"].keys()))
+    )
+    zero = {"count": 0, "total_ms": 0.0, "share": 0.0}
+    phases = {}
+    for name in names:
+        ra, rb = a["phases"].get(name, zero), b["phases"].get(name, zero)
+        phases[name] = {
+            "a_total_ms": ra["total_ms"],
+            "b_total_ms": rb["total_ms"],
+            "a_share": ra["share"],
+            "b_share": rb["share"],
+            "share_delta": round(rb["share"] - ra["share"], 4),
+            "ratio": round(rb["total_ms"] / ra["total_ms"], 3)
+            if ra["total_ms"] > 0 else None,
+        }
+    phases = dict(sorted(
+        phases.items(), key=lambda kv: -abs(kv[1]["share_delta"])
+    ))
+    ga, gb = a["boundary_gaps"], b["boundary_gaps"]
+    sa, sb = a["segments"], b["segments"]
+    return {
+        "phases": phases,
+        "segments": {
+            "a_count": sa["count"], "b_count": sb["count"],
+            "device_share_delta": round(
+                sb["device_share"] - sa["device_share"], 4),
+            "host_share_delta": round(
+                sb["host_share"] - sa["host_share"], 4),
+        },
+        "boundary_gaps": {
+            "a_count": ga["count"], "b_count": gb["count"],
+            "a_mean_ms": ga.get("mean_ms", 0.0),
+            "b_mean_ms": gb.get("mean_ms", 0.0),
+            "mean_delta_ms": round(
+                gb.get("mean_ms", 0.0) - ga.get("mean_ms", 0.0), 3),
+            "a_max_ms": ga["max_ms"], "b_max_ms": gb["max_ms"],
+            "max_delta_ms": round(gb["max_ms"] - ga["max_ms"], 3),
+            "buckets_ms": ga["buckets_ms"],
+            "a_histogram": ga["histogram"],
+            "b_histogram": gb["histogram"],
+        },
+    }
+
+
+def render_compare(cmp: dict, label_a: str, label_b: str) -> str:
+    lines = [
+        f"compare: A={label_a}  B={label_b}  (deltas are B - A)",
+        "",
+        f"{'phase':<24} {'A share':>8} {'B share':>8} {'delta':>8} "
+        f"{'B/A ms':>7}",
+    ]
+    for name, row in cmp["phases"].items():
+        ratio = f"{row['ratio']:>7.2f}" if row["ratio"] is not None \
+            else f"{'new':>7}"
+        lines.append(
+            f"{name:<24} {row['a_share']:>8.1%} {row['b_share']:>8.1%} "
+            f"{row['share_delta']:>+8.1%} {ratio}"
+        )
+    seg = cmp["segments"]
+    lines += [
+        "",
+        f"segments: {seg['a_count']} -> {seg['b_count']}  "
+        f"device share {seg['device_share_delta']:+.1%}  "
+        f"host share {seg['host_share_delta']:+.1%}",
+    ]
+    gaps = cmp["boundary_gaps"]
+    lines += [
+        "",
+        f"boundary gaps: {gaps['a_count']} -> {gaps['b_count']}  "
+        f"mean {gaps['a_mean_ms']:.3f} -> {gaps['b_mean_ms']:.3f}ms "
+        f"({gaps['mean_delta_ms']:+.3f})  "
+        f"max {gaps['a_max_ms']:.3f} -> {gaps['b_max_ms']:.3f}ms "
+        f"({gaps['max_delta_ms']:+.3f})",
+    ]
+    edges = ["0"] + [str(b) for b in gaps["buckets_ms"]]
+    for i, (na, nb) in enumerate(
+            zip(gaps["a_histogram"], gaps["b_histogram"])):
+        hi = edges[i + 1] if i < len(gaps["buckets_ms"]) else "inf"
+        lines.append(f"  ({edges[i] if i else '0'}, {hi}]: {na} -> {nb}")
+    return "\n".join(lines)
 
 
 def request_events(events: List[dict], trace_id: str) -> List[dict]:
@@ -323,9 +435,15 @@ def render_text(report: dict) -> str:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="trace-report")
-    parser.add_argument("trace", help="Chrome trace-event JSON file")
+    parser.add_argument("trace", nargs="?", default=None,
+                        help="Chrome trace-event JSON file")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON")
+    parser.add_argument(
+        "--compare", nargs=2, metavar=("A.json", "B.json"), default=None,
+        help="diff two dumps (A = baseline, B = candidate): per-phase "
+             "time-share movement and the boundary-gap shift",
+    )
     parser.add_argument(
         "--format", choices=["text", "github"], default="text",
         help="github: workflow annotations + step summary lines",
@@ -342,6 +460,47 @@ def main(argv=None) -> int:
              "histogram observation for that request",
     )
     args = parser.parse_args(argv)
+
+    if args.compare is not None:
+        path_a, path_b = args.compare
+        reports = []
+        for path in (path_a, path_b):
+            try:
+                reports.append(summarize(load_events(path)))
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                msg = f"unreadable trace {path}: {e}"
+                if args.format == "github":
+                    print(f"::error title=trace-report::{msg}")
+                else:
+                    print(f"trace-report: {msg}", file=sys.stderr)
+                return 2
+        cmp = compare(reports[0], reports[1])
+        for label, path in (("A", path_a), ("B", path_b)):
+            info = load_build_info(path)
+            if info:
+                cmp.setdefault("build_info", {})[label] = info
+        if args.json:
+            print(json.dumps(cmp, indent=2))
+            return 0
+        if args.format == "github":
+            gaps = cmp["boundary_gaps"]
+            print(
+                f"::notice title=trace-report compare::"
+                f"{path_a} vs {path_b}: boundary gap mean "
+                f"{gaps['a_mean_ms']:.3f} -> {gaps['b_mean_ms']:.3f}ms "
+                f"({gaps['mean_delta_ms']:+.3f})"
+            )
+        print(render_compare(cmp, path_a, path_b))
+        for label in ("A", "B"):
+            info = cmp.get("build_info", {}).get(label)
+            if info:
+                fields = " ".join(
+                    f"{k}={info[k]}" for k in sorted(info))
+                print(f"build {label}: {fields}")
+        return 0
+
+    if args.trace is None:
+        parser.error("trace file required (or use --compare A B)")
 
     try:
         events = load_events(args.trace)
